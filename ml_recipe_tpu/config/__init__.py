@@ -1,0 +1,21 @@
+from .parser import (
+    ConfigArgumentParser,
+    cast2,
+    get_params,
+    write_config_file,
+    load_config_file,
+    get_model_parser,
+    get_trainer_parser,
+    get_predictor_parser,
+)
+
+__all__ = [
+    "ConfigArgumentParser",
+    "cast2",
+    "get_params",
+    "write_config_file",
+    "load_config_file",
+    "get_model_parser",
+    "get_trainer_parser",
+    "get_predictor_parser",
+]

@@ -1,0 +1,452 @@
+"""Layered file+CLI config system.
+
+Parity target: reference ``modules/model/utils/parser.py`` (configargparse-based,
+~50 flags across three composable parsers, ``key = value`` config files, the
+``'None'``-string cast, multi-parser routing via unused-arg intersection in
+``get_params`` parser.py:9-31, and reproducibility round-trip via
+``write_config_file`` parser.py:38-50 / ``load_config_file`` parser.py:53-57).
+
+Re-implemented first-party (no configargparse dependency) on top of argparse:
+config files are pre-parsed into defaults, and config-file keys unknown to a
+given parser are surfaced through ``parse_known_args`` exactly like
+configargparse does, so the reference's routing trick — feeding one cfg file to
+both the model parser and the trainer parser and erroring only on keys *neither*
+recognises — works identically.
+
+TPU deltas (flag names kept wherever the concept survives):
+- ``apex_level`` is accepted for config compatibility and mapped onto the
+  native ``precision`` policy (O1/O2/O3 -> bf16, O0/None -> f32); Apex itself
+  (reference trainer.py:23-32) has no TPU equivalent or need.
+- NCCL flags (``dist_backend``/``dist_init_method``/``dist_world_size``/
+  ``local_rank``, reference parser.py:162-170) survive with the same names but
+  drive ``jax.distributed.initialize`` + mesh construction instead of a TCP
+  process-group rendezvous.
+- ``mesh`` adds explicit device-mesh axis sizing (``data:8,model:1,seq:1``),
+  which has no reference counterpart (the reference is data-parallel only).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import shlex
+import sys
+from pathlib import Path
+from typing import Callable, Iterable, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+def cast2(type_):
+    """'None'-string-aware cast (reference parser.py:34-35)."""
+    return lambda x: type_(x) if x != "None" else None
+
+
+def _str2bool(value: str) -> bool:
+    return str(value).strip().lower() in ("1", "true", "yes", "on")
+
+
+def parse_mesh_spec(spec: Optional[str]) -> dict:
+    """Parse ``"data:8,model:1"`` / ``"data=8,model=1"`` into an ordered dict."""
+    if not spec:
+        return {}
+    axes = {}
+    for part in spec.replace("=", ":").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, size = part.partition(":")
+        axes[name.strip()] = int(size)
+    return axes
+
+
+class ConfigArgumentParser(argparse.ArgumentParser):
+    """argparse with configargparse-style ``key = value`` config-file layering.
+
+    Arguments registered with ``is_config_file=True`` name the config-file
+    options; files listed there are read before parsing and their values
+    injected as defaults (CLI always wins). Keys a parser does not know are
+    returned as pseudo-args (``--key=value``) from ``parse_known_args`` so
+    multi-parser routing can intersect them (reference parser.py:9-31).
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._config_file_dests: List[str] = []
+
+    def add_argument(self, *args, **kwargs):  # type: ignore[override]
+        is_config_file = kwargs.pop("is_config_file", False)
+        action = super().add_argument(*args, **kwargs)
+        if is_config_file:
+            self._config_file_dests.append(action.dest)
+        return action
+
+    # -- config file handling -------------------------------------------------
+
+    @staticmethod
+    def read_config_file(path) -> dict:
+        """Read ``key = value`` lines; '#'/';' comments; later keys win."""
+        items: dict = {}
+        with open(path, "r") as fh:
+            for raw in fh:
+                line = raw.strip()
+                if not line or line.startswith("#") or line.startswith(";"):
+                    continue
+                key, sep, value = line.partition("=")
+                if not sep:
+                    continue
+                items[key.strip()] = value.split("#")[0].strip()
+        return items
+
+    def _find_config_files(self, args: Sequence[str]) -> List[str]:
+        option_names = {}
+        for action in self._actions:
+            if action.dest in self._config_file_dests:
+                for opt in action.option_strings:
+                    option_names[opt] = action.dest
+        files = []
+        it = iter(range(len(args)))
+        for i in it:
+            arg = args[i]
+            if "=" in arg and arg.split("=", 1)[0] in option_names:
+                files.append(arg.split("=", 1)[1])
+            elif arg in option_names and i + 1 < len(args):
+                files.append(args[i + 1])
+        return files
+
+    def _apply_config_items(self, items: dict) -> List[str]:
+        """Inject known keys as defaults; return unknown keys as pseudo-args."""
+        known = {a.dest: a for a in self._actions}
+        unknown: List[str] = []
+        for key, value in items.items():
+            action = known.get(key)
+            if action is None or action.dest in self._config_file_dests:
+                if action is None:
+                    unknown.append(f"--{key}={value}")
+                continue
+            if isinstance(action, (argparse._StoreTrueAction, argparse._StoreFalseAction)):
+                self.set_defaults(**{key: _str2bool(value)})
+            elif action.type is not None:
+                self.set_defaults(**{key: action.type(value)})
+            else:
+                self.set_defaults(**{key: value})
+        return unknown
+
+    def parse_known_args(self, args=None, namespace=None):  # type: ignore[override]
+        if args is None:
+            args = sys.argv[1:]
+        args = list(args)
+        config_unknown: List[str] = []
+        for path in self._find_config_files(args):
+            items = self.read_config_file(path)
+            config_unknown.extend(self._apply_config_items(items))
+        namespace, cli_unknown = super().parse_known_args(args, namespace)
+        return namespace, config_unknown + cli_unknown
+
+    # -- round-trip serialization --------------------------------------------
+
+    def serialize(self, config_items: dict) -> str:
+        lines = []
+        for key, value in config_items.items():
+            lines.append(f"{key} = {value}")
+        return "\n".join(lines) + "\n"
+
+
+def get_params(
+    parser_getters: Iterable[Callable[[], ConfigArgumentParser]],
+    args: Optional[Sequence[str]] = None,
+) -> Tuple[list, list]:
+    """Parse with several parsers; die only on args *no* parser recognises.
+
+    Reference parity: parser.py:9-31 (unused-arg intersection routing).
+    """
+    unused = None
+    parsers = []
+    params = []
+
+    for parser_getter in parser_getters:
+        parser = parser_getter()
+        parsed_params, unused_params = parser.parse_known_args(args)
+
+        parsers.append(parser)
+        params.append(parsed_params)
+
+        unused_set = {u.split("=", 1)[0] for u in unused_params}
+        unused = unused_set if unused is None else unused.intersection(unused_set)
+
+    if unused:
+        for parser in parsers:
+            parser.print_help()
+        raise SystemExit(f"Incorrect command line parameters: {sorted(unused)}.")
+
+    return parsers, params
+
+
+def write_config_file(parser: ConfigArgumentParser, parsed_namespace, output_path) -> None:
+    """Serialize the effective config into the experiment dir (parser.py:38-50)."""
+    config_items = {
+        k: getattr(parsed_namespace, k)
+        for k in sorted(parsed_namespace.__dict__.keys())
+        if "config" not in k
+    }
+    file_contents = parser.serialize(config_items)
+
+    try:
+        with open(output_path, "w") as output_file:
+            output_file.write(file_contents)
+    except IOError as e:
+        logger.error(f"Could not open file {output_path}.")
+        raise e
+
+    logger.info(f"Config was saved to {output_path}.")
+
+
+def load_config_file(parser_getter, config_path):
+    """Reload a serialized config (notebook path, parser.py:53-57)."""
+    parser = parser_getter()
+    parsed_params, _ = parser.parse_known_args(shlex.split(f"-c {config_path}"))
+    return parser, parsed_params
+
+
+# ---------------------------------------------------------------------------
+# Parser factories — flag surface parity with reference parser.py:60-207.
+# ---------------------------------------------------------------------------
+
+MODEL_CHOICES = [
+    "bert-base-uncased",
+    "bert-large-uncased",
+    "roberta-base",
+    "roberta-large",
+]
+
+
+def get_model_parser() -> ConfigArgumentParser:
+    parser = ConfigArgumentParser(description="Model config parser.", add_help=False)
+
+    parser.add_argument("-c", "--config_file", required=False, is_config_file=True,
+                        help="Config file path.")
+    parser.add_argument("--model_config_file", required=False, is_config_file=True,
+                        help="Model config file path.")
+
+    parser.add_argument("--model", type=str, default="bert-base-uncased",
+                        choices=MODEL_CHOICES, help="Transformer model name.")
+
+    parser.add_argument("--hidden_dropout_prob", type=float, default=0.1,
+                        help="Model dropout probability.")
+    parser.add_argument("--attention_probs_dropout_prob", type=float, default=0.1,
+                        help="Attention dropout probability.")
+    parser.add_argument("--layer_norm_eps", type=float, default=1e-12, help="Layer norm eps.")
+
+    parser.add_argument("--vocab_file", type=cast2(str), default=None,
+                        help="Path to WordPiece/BPE vocab.")
+    parser.add_argument("--merges_file", type=cast2(str), default=None,
+                        help="BPE merge table path.")
+
+    parser.add_argument("--lowercase", action="store_true", help="Tokenize lowercase strings.")
+    parser.add_argument("--handle_chinese_chars", action="store_true",
+                        help="Do not replace chinese symbols with UNK tokens.")
+
+    # TPU-native additions (no reference counterpart):
+    parser.add_argument("--hf_checkpoint", type=cast2(str), default=None,
+                        help="HF pretrained dir/name to convert initial weights from "
+                             "(None = random init).")
+    parser.add_argument("--param_dtype", type=str, default="float32",
+                        choices=["float32", "bfloat16"], help="Parameter dtype.")
+    parser.add_argument("--compute_dtype", type=str, default="bfloat16",
+                        choices=["float32", "bfloat16"],
+                        help="Activation/matmul dtype (native mixed precision; "
+                             "replaces Apex AMP levels).")
+    parser.add_argument("--flash_attention", type=cast2(str), default="auto",
+                        choices=[None, "auto", "pallas", "xla"],
+                        help="Attention implementation: pallas kernel, plain XLA, "
+                             "or auto (pallas on TPU).")
+    parser.add_argument("--remat", action="store_true",
+                        help="Rematerialize encoder layers (jax.checkpoint) to trade "
+                             "FLOPs for HBM.")
+
+    return parser
+
+
+def init_base_arguments(parser: ConfigArgumentParser) -> None:
+    parser.add_argument("-c", "--config_file", required=False, is_config_file=True,
+                        help="Config file path.")
+
+    parser.add_argument("--data_path", type=str, default=None,
+                        help="Path to JSON with documents.")
+    parser.add_argument("--processed_data_path", type=str, default=None,
+                        help="Path where processed dataset will be saved.")
+
+    parser.add_argument("--gpu", action="store_true",
+                        help="Accepted for reference-config compatibility; device "
+                             "selection on TPU is automatic.")
+
+    parser.add_argument("--max_seq_len", type=int, default=384, help="Max input seq length.")
+    parser.add_argument("--max_question_len", type=int, default=64, help="Max question length.")
+    parser.add_argument("--doc_stride", type=int, default=128,
+                        help="Step size during doc splitting.")
+
+    parser.add_argument("--split_by_sentence", action="store_true",
+                        help="Split document by sentence instead.")
+    parser.add_argument("--truncate", action="store_true",
+                        help="Cut off long sentences during splitting by sentence.")
+
+    parser.add_argument("--n_jobs", type=int, default=16,
+                        help="Number of host-side data pipeline workers.")
+
+
+def get_trainer_parser() -> ConfigArgumentParser:
+    parser = ConfigArgumentParser(description="Trainer config parser.", add_help=False)
+    init_base_arguments(parser)
+
+    parser.add_argument("--trainer_config_file", required=False, is_config_file=True,
+                        help="Trainer config file path.")
+
+    parser.add_argument("--dump_dir", type=Path, default=Path("./results"), help="Dump path.")
+    parser.add_argument("--experiment_name", type=str, default="test", help="Experiment name.")
+
+    parser.add_argument("--last", type=cast2(str), default=None, help="Restored checkpoint.")
+
+    parser.add_argument("--seed", type=cast2(int), default=None, help="Seed for random state.")
+
+    parser.add_argument("--n_epochs", type=int, default=10, help="Number of epochs.")
+
+    parser.add_argument("--train_batch_size", type=int, default=128,
+                        help="Global number of items in an optimizer-step batch.")
+    parser.add_argument("--test_batch_size", type=int, default=16,
+                        help="Number of items in batch.")
+    parser.add_argument("--batch_split", type=int, default=1,
+                        help="Micro-batch count for gradient accumulation "
+                             "(lax.scan inside the jitted step).")
+
+    parser.add_argument("--lr", type=float, default=1e-5, help="Learning rate for optimizer.")
+    parser.add_argument("--weight_decay", type=float, default=0.01,
+                        help="Weight decay for optimizer.")
+
+    parser.add_argument("--clear_processed", action="store_true",
+                        help="Clear previous processed dataset.")
+
+    parser.add_argument("--w_start", type=float, default=1,
+                        help="Weight of start position classification.")
+    parser.add_argument("--w_end", type=float, default=1,
+                        help="Weight of end position classification.")
+    parser.add_argument("--w_start_reg", type=float, default=0,
+                        help="Weight of start position regression loss.")
+    parser.add_argument("--w_end_reg", type=float, default=0,
+                        help="Weight of end position regression loss.")
+    parser.add_argument("--w_cls", type=float, default=1,
+                        help="Weight of doc label classification.")
+
+    parser.add_argument("--loss", type=str, default="ce", choices=["ce", "focal", "smooth"],
+                        help="Type of doc label classification loss")
+
+    parser.add_argument("--smooth_alpha", type=float, default=0.01,
+                        help="Smooth CE loss parameter.")
+    parser.add_argument("--focal_alpha", type=float, default=1, help="Focal loss parameter.")
+    parser.add_argument("--focal_gamma", type=float, default=2, help="Focal loss parameter.")
+
+    parser.add_argument("--max_grad_norm", type=float, default=1,
+                        help="Max global norm of the gradients")
+    parser.add_argument("--sync_bn", action="store_true",
+                        help="Cross-replica normalization statistics sync (reference "
+                             "SyncBN flag; BERT has LayerNorm so this is a no-op "
+                             "unless BatchNorm layers are present).")
+
+    parser.add_argument("--warmup_coef", type=float, default=0.05, help="Warmup coefficient.")
+
+    # Mixed precision: native policy + accepted Apex aliases.
+    parser.add_argument("--precision", type=cast2(str), default=None,
+                        choices=[None, "f32", "bf16"],
+                        help="Mixed-precision policy. None defers to apex_level mapping.")
+    parser.add_argument("--apex_level", type=cast2(str),
+                        choices=[None, "O0", "O1", "O2", "O3"], default=None,
+                        help="Reference-compat alias: O1/O2/O3 -> bf16, O0/None -> f32.")
+    parser.add_argument("--apex_verbosity", type=int, default=1,
+                        help="Accepted for config compatibility.")
+    parser.add_argument("--apex_loss_scale", type=cast2(float), default=None,
+                        help="Loss scale; bf16 on TPU normally needs none.")
+
+    parser.add_argument("--drop_optimizer", action="store_true",
+                        help="Not restore optimizer and scheduler from checkpoint.")
+
+    parser.add_argument("--debug", action="store_true", help="Debug mode.")
+    parser.add_argument("--dummy_dataset", action="store_true",
+                        help="Use generated dataset instead real data.")
+
+    # Distributed: reference names preserved, XLA semantics underneath.
+    parser.add_argument("--local_rank", type=int, default=-1,
+                        help="Process index of this host (reference name kept; feeds "
+                             "jax.distributed.initialize process_id).")
+    parser.add_argument("--dist_backend", type=str, default="xla", choices=["xla", "nccl"],
+                        help="Accepted for compatibility; collectives always run "
+                             "through XLA over ICI/DCN.")
+    parser.add_argument("--dist_init_method", type=str, default="tcp://127.0.0.1:9080",
+                        help="Coordinator address (host:port); tcp:// prefix accepted "
+                             "for reference compatibility.")
+    parser.add_argument("--dist_world_size", type=int, default=1,
+                        help="Number of host processes.")
+    parser.add_argument("--mesh", type=cast2(str), default=None,
+                        help="Device mesh axes, e.g. 'data:8' or 'data:4,model:2' or "
+                             "'data:2,seq:4'. None = all devices on the data axis.")
+
+    parser.add_argument("--best_metric", choices=["map"], type=str, default="map",
+                        help="Best metric name.")
+    parser.add_argument("--best_order", choices=[">", "<"], type=str, default=">",
+                        help="Best metric order.")
+
+    parser.add_argument("--finetune", action="store_true", help="Turn on finetune mode.")
+    parser.add_argument("--finetune_transformer", action="store_true",
+                        help="Finetune transformer module.")
+    parser.add_argument("--finetune_position", action="store_true",
+                        help="Finetune classification head.")
+    parser.add_argument("--finetune_position_reg", action="store_true",
+                        help="Finetune regression head.")
+    parser.add_argument("--finetune_class", action="store_true",
+                        help="Finetune doc label classification head.")
+
+    parser.add_argument("--bpe_dropout", type=cast2(float), default=None, help="Use BPE dropout.")
+
+    parser.add_argument("--optimizer", type=str, default="adam", choices=["adam", "adamod"],
+                        help="Optimizer name.")
+
+    parser.add_argument("--train_label_weights", action="store_true",
+                        help="Use label weights in CE loss.")
+    parser.add_argument("--train_sampler_weights", action="store_true",
+                        help="Use oversampling.")
+
+    parser.add_argument("--log_file", type=str, default=None,
+                        help="This parameter is ignored. After dump will consist "
+                             "path to log file.")
+
+    return parser
+
+
+def get_predictor_parser() -> ConfigArgumentParser:
+    parser = ConfigArgumentParser(description="Validation config parser.", add_help=False)
+    init_base_arguments(parser)
+
+    parser.add_argument("--predictor_config_file", required=False, is_config_file=True,
+                        help="Predictor config file path.")
+
+    parser.add_argument("--checkpoint", type=cast2(str), default=None,
+                        help="Restored checkpoint path.")
+
+    parser.add_argument("--batch_size", type=int, default=16, help="Batch size.")
+    parser.add_argument("--buffer_size", type=int, default=4096, help="Buffer queue size.")
+
+    parser.add_argument("--limit", type=cast2(int), default=None,
+                        help="Process only specified number of documents.")
+
+    parser.add_argument("--gpu_compat", action="store_true",
+                        help="Accepted for reference-config compatibility.")
+
+    return parser
+
+
+def resolve_precision(params) -> str:
+    """Map (precision, apex_level) onto the native policy: 'bf16' or 'f32'."""
+    if getattr(params, "precision", None):
+        return params.precision
+    apex_level = getattr(params, "apex_level", None)
+    if apex_level in ("O1", "O2", "O3"):
+        return "bf16"
+    return "f32"

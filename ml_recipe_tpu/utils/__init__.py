@@ -1,0 +1,12 @@
+from .logging import get_logger, show_params
+from .seed import set_seed, RngPool
+from .profiler import time_profiler, StepTimer
+
+__all__ = [
+    "get_logger",
+    "show_params",
+    "set_seed",
+    "RngPool",
+    "time_profiler",
+    "StepTimer",
+]

@@ -1,0 +1,69 @@
+"""Determinism utilities.
+
+Parity target: reference ``modules/utils.py:34-45`` (``set_seed`` seeds python /
+numpy / torch and flips cuDNN determinism knobs). On TPU the device-side story
+is simpler: JAX PRNG is deterministic by construction, so only the *host-side*
+RNGs (python ``random``, numpy — used for weighted chunk sampling and shuffles)
+need seeding, plus a root ``jax.random`` key for device-side randomness
+(dropout), which we thread explicitly through the train step.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def set_seed(seed: Optional[int] = None) -> Optional["RngPool"]:
+    """Seed host RNGs and build the root device-key pool.
+
+    Returns an :class:`RngPool` (or ``None`` when ``seed`` is ``None``, matching
+    the reference's behaviour of leaving RNGs unseeded unless asked).
+    """
+    if seed is None:
+        return None
+
+    random.seed(seed)
+    np.random.seed(seed)
+
+    logger.info(
+        f"Random seed was set to {seed}. Host numpy/python RNGs seeded; "
+        f"device randomness is keyed from the same seed."
+    )
+    return RngPool(seed)
+
+
+@dataclass
+class RngPool:
+    """Deterministic source of ``jax.random`` keys.
+
+    The reference relied on global torch/cuDNN seeding; JAX requires explicit
+    key threading. The pool hands out a fresh fold of the root key per
+    (purpose, step) pair so dropout/BPE-dropout streams never collide and
+    resuming at step N reproduces the exact key sequence.
+    """
+
+    seed: int
+    _purposes: dict = field(default_factory=dict)
+
+    def key(self, purpose: str, step: int = 0):
+        import jax
+
+        if purpose not in self._purposes:
+            self._purposes[purpose] = len(self._purposes) + 1
+        root = jax.random.key(self.seed)
+        return jax.random.fold_in(jax.random.fold_in(root, self._purposes[purpose]), step)
+
+    def host_rng(self, purpose: str, step: int = 0) -> np.random.Generator:
+        """Numpy generator for host-side sampling (weighted chunk choice)."""
+        if purpose not in self._purposes:
+            self._purposes[purpose] = len(self._purposes) + 1
+        return np.random.default_rng(
+            np.random.SeedSequence([self.seed, self._purposes[purpose], step])
+        )

@@ -1,0 +1,169 @@
+"""Config-system tests (parity with reference parser.py semantics)."""
+
+import textwrap
+
+import pytest
+
+from ml_recipe_tpu.config import (
+    cast2,
+    get_model_parser,
+    get_params,
+    get_predictor_parser,
+    get_trainer_parser,
+    load_config_file,
+    write_config_file,
+)
+from ml_recipe_tpu.config.parser import parse_mesh_spec, resolve_precision
+
+
+def test_cast2_none_string():
+    assert cast2(int)("None") is None
+    assert cast2(int)("3") == 3
+    assert cast2(str)("None") is None
+    assert cast2(float)("1e-3") == 1e-3
+
+
+def test_trainer_parser_defaults():
+    parser = get_trainer_parser()
+    params, unused = parser.parse_known_args([])
+    assert unused == []
+    assert params.train_batch_size == 128
+    assert params.batch_split == 1
+    assert params.loss == "ce"
+    assert params.local_rank == -1
+    assert params.optimizer == "adam"
+
+
+def test_config_file_layering(tmp_path):
+    cfg = tmp_path / "test.cfg"
+    cfg.write_text(textwrap.dedent("""\
+        # comment line
+        model=bert-base-uncased
+        train_batch_size=256
+        batch_split = 128
+        loss = smooth
+        smooth_alpha = 0.01
+        debug=True
+        dummy_dataset=True
+        lowercase=True
+        max_seq_len=512
+    """))
+
+    parser = get_trainer_parser()
+    params, unused = parser.parse_known_args(["-c", str(cfg)])
+    assert params.train_batch_size == 256
+    assert params.batch_split == 128
+    assert params.loss == "smooth"
+    assert params.debug is True
+    assert params.dummy_dataset is True
+    assert params.max_seq_len == 512
+    # keys the trainer parser does not know surface as pseudo-args
+    assert any(u.startswith("--model=") for u in unused)
+    assert any(u.startswith("--lowercase=") for u in unused)
+
+
+def test_cli_overrides_config_file(tmp_path):
+    cfg = tmp_path / "test.cfg"
+    cfg.write_text("train_batch_size=256\n")
+    parser = get_trainer_parser()
+    params, _ = parser.parse_known_args(["-c", str(cfg), "--train_batch_size", "64"])
+    assert params.train_batch_size == 64
+
+
+def test_get_params_multi_parser_routing(tmp_path):
+    """One cfg with model+trainer keys parses cleanly through both parsers."""
+    cfg = tmp_path / "both.cfg"
+    cfg.write_text("model=roberta-base\nlowercase=True\ntrain_batch_size=32\nloss=focal\n")
+    (parsers, params) = get_params(
+        (get_trainer_parser, get_model_parser), ["-c", str(cfg)]
+    )
+    trainer_params, model_params = params[0], params[1]
+    assert trainer_params.train_batch_size == 32
+    assert trainer_params.loss == "focal"
+    assert model_params.model == "roberta-base"
+    assert model_params.lowercase is True
+
+
+def test_get_params_rejects_truly_unknown(tmp_path):
+    with pytest.raises(SystemExit):
+        get_params((get_trainer_parser, get_model_parser), ["--definitely_not_a_flag", "1"])
+
+
+def test_write_and_load_roundtrip(tmp_path):
+    parser = get_trainer_parser()
+    params, _ = parser.parse_known_args(
+        ["--train_batch_size", "48", "--loss", "smooth", "--experiment_name", "exp1"]
+    )
+    out = tmp_path / "trainer.cfg"
+    write_config_file(parser, params, out)
+    assert out.exists()
+
+    _, reloaded = load_config_file(get_trainer_parser, out)
+    assert reloaded.train_batch_size == 48
+    assert reloaded.loss == "smooth"
+    assert reloaded.experiment_name == "exp1"
+    # config-file keys themselves are excluded from the round trip
+    assert "config_file" not in out.read_text()
+
+
+def test_reference_cfg_format_parses(tmp_path):
+    """The reference's shipped test_bert.cfg style must parse unchanged."""
+    cfg = tmp_path / "ref.cfg"
+    cfg.write_text(textwrap.dedent("""\
+        model=bert-base-uncased
+        vocab_file=./data/bert-base-uncased-vocab.txt
+        merges_file=None
+        lowercase=True
+        n_epochs=2
+        train_batch_size=256
+        batch_split=128
+        warmup_coef=0.6
+        apex_level=O1
+        apex_verbosity=0
+        lr=1e-5
+        weight_decay=1e-4
+        max_grad_norm=1
+        sync_bn=True
+        last=None
+        seed=None
+        debug=True
+        dummy_dataset=True
+    """))
+    (_, (trainer_params, model_params)) = get_params(
+        (get_trainer_parser, get_model_parser), ["-c", str(cfg)]
+    )
+    assert trainer_params.n_epochs == 2
+    assert trainer_params.apex_level == "O1"
+    assert trainer_params.last is None
+    assert trainer_params.seed is None
+    assert model_params.merges_file is None
+    assert resolve_precision(trainer_params) == "bf16"
+
+
+def test_resolve_precision_mapping():
+    class P:
+        precision = None
+        apex_level = None
+
+    assert resolve_precision(P()) == "f32"
+    P.apex_level = "O2"
+    assert resolve_precision(P()) == "bf16"
+    P.precision = "f32"
+    assert resolve_precision(P()) == "f32"
+
+
+def test_parse_mesh_spec():
+    assert parse_mesh_spec(None) == {}
+    assert parse_mesh_spec("data:8") == {"data": 8}
+    assert parse_mesh_spec("data:4,model:2") == {"data": 4, "model": 2}
+    assert parse_mesh_spec("data=2, seq=4") == {"data": 2, "seq": 4}
+
+
+def test_predictor_parser():
+    parser = get_predictor_parser()
+    params, _ = parser.parse_known_args(["--checkpoint", "best.ch", "--limit", "100"])
+    assert params.checkpoint == "best.ch"
+    assert params.limit == 100
+    params, _ = parser.parse_known_args(["--checkpoint", "None", "--limit", "None"])
+    assert params.checkpoint is None
+    assert params.limit is None

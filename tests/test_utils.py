@@ -1,0 +1,64 @@
+import logging
+
+import numpy as np
+
+from ml_recipe_tpu.utils import RngPool, get_logger, set_seed, time_profiler
+from ml_recipe_tpu.utils.profiler import StepTimer
+
+
+def test_get_logger_resets_handlers(tmp_path):
+    log_file = tmp_path / "run.log"
+    logger = get_logger(filename=str(log_file), logger_name="t1")
+    logger.info("hello")
+    # second call must not duplicate handlers
+    get_logger(logger_name="t2")
+    assert len(logging.root.handlers) == 1
+    assert "hello" in log_file.read_text()
+
+
+def test_set_seed_determinism():
+    set_seed(123)
+    a = np.random.rand(4)
+    set_seed(123)
+    b = np.random.rand(4)
+    np.testing.assert_array_equal(a, b)
+    assert set_seed(None) is None
+
+
+def test_rng_pool_keys_distinct_and_stable():
+    import jax
+
+    pool = RngPool(7)
+    k1 = pool.key("dropout", step=0)
+    k2 = pool.key("dropout", step=1)
+    k3 = pool.key("bpe", step=0)
+    d1 = jax.random.key_data(k1)
+    assert not np.array_equal(d1, jax.random.key_data(k2))
+    assert not np.array_equal(d1, jax.random.key_data(k3))
+
+    pool2 = RngPool(7)
+    np.testing.assert_array_equal(d1, jax.random.key_data(pool2.key("dropout", step=0)))
+
+
+def test_rng_pool_host_rng():
+    pool = RngPool(7)
+    a = pool.host_rng("sample", 3).random(5)
+    b = RngPool(7).host_rng("sample", 3).random(5)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_time_profiler_passthrough():
+    @time_profiler
+    def add(a, b):
+        return a + b
+
+    assert add(2, 3) == 5
+
+
+def test_step_timer():
+    t = StepTimer(warmup=1)
+    for _ in range(3):
+        t.start()
+        t.stop()
+    assert t.count == 3
+    assert t.mean() >= 0.0
