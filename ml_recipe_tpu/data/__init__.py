@@ -1,0 +1,19 @@
+from .preprocessor import LineDataExtractor, RawPreprocessor
+from .datasets import DatasetItem, ChunkItem, SplitDataset, ChunkDataset, DummyDataset
+from .collate import collate_fun, make_collate_fun
+from .loader import DataLoader, ListDataloader, ShardedBatchSampler
+
+__all__ = [
+    "LineDataExtractor",
+    "RawPreprocessor",
+    "DatasetItem",
+    "ChunkItem",
+    "SplitDataset",
+    "ChunkDataset",
+    "DummyDataset",
+    "collate_fun",
+    "make_collate_fun",
+    "DataLoader",
+    "ListDataloader",
+    "ShardedBatchSampler",
+]

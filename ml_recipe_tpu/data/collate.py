@@ -1,0 +1,79 @@
+"""Fixed-shape batch collation.
+
+Parity target: reference split_dataset.py:480-520 (``collate_fun``) — pads the
+batch, builds attention_mask and BERT token_type_ids, packs the 5-key label
+dict, optional raw-items passthrough for inference.
+
+TPU-first delta (SURVEY.md §7 hard part (a)): the reference pads to the
+*per-batch max length* (split_dataset.py:484), giving dynamic shapes that
+would retrigger XLA compilation every step. Here every batch is padded to the
+static ``max_seq_len``, so one compiled program serves the whole run. The
+attention mask is derived from true lengths (the reference's ``tokens > 0``
+trick breaks for RoBERTa whose pad id is 1).
+
+Outputs are numpy (host) arrays; device placement/sharding happens in the
+training loop.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import numpy as np
+
+
+def collate_fun(items, tokenizer, *, max_seq_len: Optional[int] = None, return_items: bool = False):
+    batch_size = len(items)
+    pad_token_id = tokenizer.pad_token_id
+
+    lengths = np.asarray([len(item.input_ids) for item in items], dtype=np.int32)
+    target_len = int(max_seq_len) if max_seq_len is not None else int(lengths.max())
+    assert lengths.max() <= target_len, (
+        f"Item of length {lengths.max()} exceeds static max_seq_len {target_len}."
+    )
+
+    tokens = np.full((batch_size, target_len), pad_token_id, dtype=np.int32)
+    token_type_ids = np.zeros((batch_size, target_len), dtype=np.int32)
+
+    is_bert = getattr(tokenizer, "model_name", "bert") == "bert"
+    sep_token_id = tokenizer.sep_token_id
+
+    for i, item in enumerate(items):
+        row = item.input_ids
+        tokens[i, : len(row)] = row
+        if is_bert:
+            # segment 0 up to and including the first [SEP], segment 1 after
+            # (split_dataset.py:487-495); padding stays segment 0 and is
+            # masked out anyway.
+            sep_pos = row.index(sep_token_id) if sep_token_id in row else len(row) - 1
+            token_type_ids[i, sep_pos + 1 : len(row)] = 1
+
+    positions = np.arange(target_len, dtype=np.int32)[None, :]
+    attention_mask = (positions < lengths[:, None]).astype(np.int32)
+
+    inputs = {
+        "input_ids": tokens,
+        "attention_mask": attention_mask,
+        "token_type_ids": token_type_ids,
+    }
+
+    labels = {
+        "start_class": np.asarray([item.start_id for item in items], dtype=np.int32),
+        "end_class": np.asarray([item.end_id for item in items], dtype=np.int32),
+        "start_reg": np.asarray([item.start_position for item in items], dtype=np.float32),
+        "end_reg": np.asarray([item.end_position for item in items], dtype=np.float32),
+        "cls": np.asarray([item.label_id for item in items], dtype=np.int32),
+    }
+
+    if return_items:
+        return [inputs, labels, items]
+
+    return [inputs, labels]
+
+
+def make_collate_fun(tokenizer, *, max_seq_len: Optional[int] = None, return_items: bool = False):
+    """Bind tokenizer/shape args (reference init.py:204-205 ``init_collate_fun``)."""
+    return functools.partial(
+        collate_fun, tokenizer=tokenizer, max_seq_len=max_seq_len, return_items=return_items
+    )

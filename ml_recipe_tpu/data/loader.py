@@ -1,0 +1,215 @@
+"""Host-side input pipeline.
+
+Replaces three reference mechanisms with one SPMD-aware design:
+
+- ``torch.utils.data.DataLoader`` + worker processes (trainer.py:168-181):
+  here a thread-pool prefetch pipeline producing fixed-shape numpy batches.
+- ``DistributedSampler`` / ``RandomSampler`` / ``WeightedRandomSampler``
+  (trainer.py:150-166): here :class:`ShardedBatchSampler` — every host draws
+  the SAME deterministic global index sequence (seeded per epoch) and takes
+  its own contiguous slice of each global batch, so the union over hosts is
+  exactly one global batch per step with no coordination traffic.
+- ``ListDataloader`` (utils/list_dataloader.py): mp.Pool streaming of
+  variable chunks-per-doc for inference; here a thread/process pool feeding a
+  bounded queue, re-batched to a fixed batch size across document boundaries.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class ShardedBatchSampler:
+    """Deterministic per-host batch index sampler.
+
+    Each epoch: build one global ordering (shuffled, or weighted-with-
+    replacement when ``weights`` is given — WeightedRandomSampler parity,
+    trainer.py:159-160), chop into global batches of ``global_batch_size``,
+    and yield this host's ``[process_index]``-th slice of each. ``drop_last``
+    mirrors the reference's train dataloader (trainer.py:105).
+    """
+
+    def __init__(
+        self,
+        dataset_len: int,
+        global_batch_size: int,
+        *,
+        process_index: int = 0,
+        process_count: int = 1,
+        shuffle: bool = True,
+        weights: Optional[Sequence[float]] = None,
+        drop_last: bool = True,
+        seed: int = 0,
+    ):
+        assert global_batch_size % process_count == 0, (
+            f"global batch {global_batch_size} must divide over {process_count} hosts"
+        )
+        self.dataset_len = dataset_len
+        self.global_batch_size = global_batch_size
+        self.local_batch_size = global_batch_size // process_count
+        self.process_index = process_index
+        self.process_count = process_count
+        self.shuffle = shuffle
+        self.weights = None if weights is None else np.asarray(weights, dtype=np.float64)
+        self.drop_last = drop_last
+        self.seed = seed
+
+    def __len__(self) -> int:
+        if self.drop_last:
+            return self.dataset_len // self.global_batch_size
+        return (self.dataset_len + self.global_batch_size - 1) // self.global_batch_size
+
+    def epoch_indices(self, epoch: int) -> np.ndarray:
+        rng = np.random.default_rng(np.random.SeedSequence([self.seed, epoch]))
+        if self.weights is not None:
+            p = self.weights / self.weights.sum()
+            return rng.choice(self.dataset_len, size=self.dataset_len, replace=True, p=p)
+        if self.shuffle:
+            return rng.permutation(self.dataset_len)
+        return np.arange(self.dataset_len)
+
+    def __call__(self, epoch: int) -> Iterator[np.ndarray]:
+        indices = self.epoch_indices(epoch)
+        n_batches = len(self)
+        for b in range(n_batches):
+            global_batch = indices[b * self.global_batch_size : (b + 1) * self.global_batch_size]
+            if len(global_batch) < self.global_batch_size and self.drop_last:
+                return
+            lo = self.process_index * self.local_batch_size
+            hi = lo + self.local_batch_size
+            yield global_batch[lo:hi]
+
+
+class DataLoader:
+    """Prefetching map-style loader producing collated fixed-shape batches."""
+
+    def __init__(
+        self,
+        dataset,
+        sampler: ShardedBatchSampler,
+        collate_fun: Callable,
+        *,
+        n_jobs: int = 4,
+        prefetch: int = 4,
+    ):
+        self.dataset = dataset
+        self.sampler = sampler
+        self.collate_fun = collate_fun
+        self.n_jobs = max(1, n_jobs)
+        self.prefetch = max(1, prefetch)
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    def __len__(self) -> int:
+        return len(self.sampler)
+
+    def _load_batch(self, batch_indices: np.ndarray):
+        items = [self.dataset[int(i)] for i in batch_indices]
+        return self.collate_fun(items)
+
+    def __iter__(self):
+        batches = list(self.sampler(self._epoch))
+        if not batches:
+            return
+        with ThreadPoolExecutor(max_workers=self.n_jobs) as pool:
+            futures: list = []
+            it = iter(batches)
+            for _ in range(min(self.prefetch, len(batches))):
+                futures.append(pool.submit(self._load_batch, next(it)))
+            pending = len(batches) - len(futures)
+            i = 0
+            while futures:
+                fut = futures.pop(0)
+                if pending > 0:
+                    futures.append(pool.submit(self._load_batch, next(it)))
+                    pending -= 1
+                yield fut.result()
+                i += 1
+
+
+class ListDataloader:
+    """Async loader for datasets whose ``__getitem__`` returns a LIST of chunks.
+
+    Parity target: utils/list_dataloader.py:9-97 — a worker pool expands one
+    document into its chunk list and streams chunks into a bounded queue; the
+    consumer re-batches to a fixed ``batch_size`` across document boundaries.
+    Exists because variable chunks-per-doc breaks the 1-item→1-row assumption
+    of the map-style loader (reference validate.py:37 todo).
+    """
+
+    _SENTINEL = object()
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        *,
+        n_jobs: int = 4,
+        collate_fun: Optional[Callable] = None,
+        buffer_size: int = 1024,
+        shuffle: bool = False,
+        seed: int = 0,
+    ):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fun = collate_fun
+        self.n_jobs = max(1, n_jobs)
+        self.buffer_size = buffer_size
+        self.shuffle = shuffle
+        self.seed = seed
+
+    def process_batch(self, batch):
+        return self.collate_fun(batch) if self.collate_fun is not None else batch
+
+    def __iter__(self):
+        idxs = np.arange(len(self.dataset))
+        if self.shuffle:
+            np.random.default_rng(self.seed).shuffle(idxs)
+
+        q: queue.Queue = queue.Queue(maxsize=self.buffer_size)
+        errors: list = []
+        done = threading.Event()
+
+        def producer():
+            try:
+                with ThreadPoolExecutor(max_workers=self.n_jobs) as pool:
+                    for chunks in pool.map(self.dataset.__getitem__, [int(i) for i in idxs]):
+                        for chunk in chunks:
+                            q.put(chunk)
+            except Exception as e:  # surface worker errors to the consumer
+                logger.error(e)
+                errors.append(e)
+            finally:
+                done.set()
+                q.put(self._SENTINEL)
+
+        thread = threading.Thread(target=producer, daemon=True)
+        thread.start()
+
+        batch = []
+        while True:
+            chunk = q.get()
+            if chunk is self._SENTINEL:
+                break
+            batch.append(chunk)
+            if len(batch) == self.batch_size:
+                yield self.process_batch(batch)
+                batch = []
+
+        if errors:
+            raise errors[0]
+
+        if batch:
+            yield self.process_batch(batch)
+
+        thread.join()

@@ -1,0 +1,5 @@
+from .facade import Tokenizer
+from .wordpiece import WordPieceTokenizer
+from .bpe import ByteLevelBPETokenizer
+
+__all__ = ["Tokenizer", "WordPieceTokenizer", "ByteLevelBPETokenizer"]

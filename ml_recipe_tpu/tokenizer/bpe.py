@@ -1,0 +1,128 @@
+"""First-party byte-level BPE tokenizer (RoBERTa/GPT-2 style).
+
+Replaces the Rust ``tokenizers.ByteLevelBPETokenizer`` the reference wraps in
+``modules/model/model/tokenizer.py:42-49``, including the optional BPE-dropout
+(Provilkov et al., 2019) the reference exposes via ``--bpe_dropout``.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from functools import lru_cache
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@lru_cache()
+def bytes_to_unicode() -> Dict[int, str]:
+    """GPT-2's reversible byte->printable-unicode map."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("\xa1"), ord("\xac") + 1))
+        + list(range(ord("\xae"), ord("\xff") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, [chr(c) for c in cs]))
+
+
+_GPT2_SPLIT = re.compile(
+    r"'s|'t|'re|'ve|'m|'ll|'d| ?[^\s\d\W]+| ?\d+| ?[^\s\w]+|\s+(?!\S)|\s+"
+)
+
+
+class ByteLevelBPETokenizer:
+    def __init__(
+        self,
+        vocab_file: str,
+        merges_file: str,
+        *,
+        dropout: Optional[float] = None,
+        rng: Optional[np.random.Generator] = None,
+    ):
+        with open(vocab_file, "r", encoding="utf-8") as fh:
+            self.vocab: Dict[str, int] = json.load(fh)
+        self.inv_vocab = {i: t for t, i in self.vocab.items()}
+
+        self.merge_ranks: Dict[Tuple[str, str], int] = {}
+        with open(merges_file, "r", encoding="utf-8") as fh:
+            for i, line in enumerate(fh):
+                line = line.strip()
+                if not line or line.startswith("#version"):
+                    continue
+                a, _, b = line.partition(" ")
+                self.merge_ranks[(a, b)] = len(self.merge_ranks)
+
+        self.byte_encoder = bytes_to_unicode()
+        self.byte_decoder = {v: k for k, v in self.byte_encoder.items()}
+        self.dropout = dropout
+        self.rng = rng if rng is not None else np.random.default_rng()
+        self._cache: Dict[str, List[str]] = {}
+
+    def __len__(self) -> int:
+        return len(self.vocab)
+
+    def _bpe(self, token: str) -> List[str]:
+        use_dropout = self.dropout is not None and self.dropout > 0
+        if not use_dropout and token in self._cache:
+            return self._cache[token]
+
+        word = list(token)
+        while len(word) > 1:
+            pairs = {(word[i], word[i + 1]) for i in range(len(word) - 1)}
+            ranked = [
+                (self.merge_ranks[p], p) for p in pairs if p in self.merge_ranks
+            ]
+            if use_dropout:
+                # BPE-dropout: each candidate merge is skipped with prob p.
+                ranked = [rp for rp in ranked if self.rng.random() >= self.dropout]
+            if not ranked:
+                break
+            _, best = min(ranked)
+            merged: List[str] = []
+            i = 0
+            while i < len(word):
+                if i < len(word) - 1 and (word[i], word[i + 1]) == best:
+                    merged.append(word[i] + word[i + 1])
+                    i += 2
+                else:
+                    merged.append(word[i])
+                    i += 1
+            word = merged
+
+        if not use_dropout:
+            self._cache[token] = word
+        return word
+
+    def tokenize(self, text: str) -> List[str]:
+        out: List[str] = []
+        for piece in _GPT2_SPLIT.findall(text):
+            mapped = "".join(self.byte_encoder[b] for b in piece.encode("utf-8"))
+            out.extend(self._bpe(mapped))
+        return out
+
+    def encode(self, text: str) -> List[int]:
+        """Token ids WITHOUT special tokens (callers add <s>/</s>)."""
+        unk = self.vocab.get("<unk>", 0)
+        return [self.vocab.get(t, unk) for t in self.tokenize(text)]
+
+    def decode(self, ids: List[int], *, skip_special_tokens: bool = True) -> str:
+        specials = {"<pad>", "</s>", "<s>", "<unk>", "<mask>"}
+        text = ""
+        for i in ids:
+            tok = self.inv_vocab.get(int(i), "<unk>")
+            if skip_special_tokens and tok in specials:
+                continue
+            text += tok
+        raw = bytearray(self.byte_decoder.get(ch, ord(" ")) for ch in text)
+        return raw.decode("utf-8", errors="replace").strip()
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        return self.vocab.get(token)

@@ -1,0 +1,73 @@
+"""Shared test fixtures/helpers: tiny vocab, tiny NQ-style corpus, toy tokenizer."""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+BASE_VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+
+WORDS = [
+    "the", "quick", "brown", "fox", "jumps", "over", "lazy", "dog",
+    "what", "is", "a", "an", "answer", "question", "yes", "no",
+    "london", "capital", "of", "england", "city", "big", "ben", "tower",
+    "in", "was", "built", "year", "river", "thames", "runs", "through",
+    "##s", "##ing", "##ed", "un", "##known", ".", ",", "?", "!",
+]
+
+
+def write_vocab(tmp_path: Path) -> Path:
+    vocab_file = tmp_path / "vocab.txt"
+    vocab_file.write_text("\n".join(BASE_VOCAB + WORDS) + "\n")
+    return vocab_file
+
+
+def make_tokenizer(tmp_path: Path):
+    from ml_recipe_tpu.tokenizer import Tokenizer
+
+    return Tokenizer("bert", str(write_vocab(tmp_path)), lowercase=True)
+
+
+def nq_line(
+    *,
+    example_id: str = "42",
+    document_text: str = (
+        "<P> London is the capital of England . </P> "
+        "<P> Big Ben was built in the city . The river Thames runs through London . </P>"
+    ),
+    question_text: str = "what is the capital of england ?",
+    yes_no_answer: str = "NONE",
+    long_start: int = 1,
+    long_end: int = 8,
+    candidate_index: int = 0,
+    short_answers=None,
+) -> dict:
+    if short_answers is None:
+        short_answers = [{"start_token": 2, "end_token": 3}]
+    return {
+        "example_id": example_id,
+        "document_text": document_text,
+        "question_text": question_text,
+        "annotations": [
+            {
+                "yes_no_answer": yes_no_answer,
+                "long_answer": {
+                    "start_token": long_start,
+                    "end_token": long_end,
+                    "candidate_index": candidate_index,
+                },
+                "short_answers": short_answers,
+            }
+        ],
+        "long_answer_candidates": [
+            {"start_token": long_start, "end_token": long_end, "top_level": True}
+        ],
+    }
+
+
+def write_corpus(tmp_path: Path, lines) -> Path:
+    raw = tmp_path / "corpus.jsonl"
+    with open(raw, "w") as fh:
+        for line in lines:
+            fh.write(json.dumps(line) + "\n")
+    return raw

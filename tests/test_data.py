@@ -1,0 +1,347 @@
+"""Data-layer tests: preprocessor, chunking, datasets, collate, loaders."""
+
+import numpy as np
+import pytest
+
+from ml_recipe_tpu.data import (
+    ChunkDataset,
+    DataLoader,
+    DummyDataset,
+    ListDataloader,
+    RawPreprocessor,
+    ShardedBatchSampler,
+    SplitDataset,
+    collate_fun,
+    make_collate_fun,
+)
+from ml_recipe_tpu.data.chunking import (
+    drop_tags_and_encode,
+    sentence_chunks,
+    truncate_record,
+    window_chunks,
+)
+from ml_recipe_tpu.data.sentence import split_sentences
+
+from helpers import make_tokenizer, nq_line, write_corpus
+
+
+# -- preprocessor -------------------------------------------------------------
+
+
+def _prepare(tmp_path, lines):
+    raw = write_corpus(tmp_path, lines)
+    out = tmp_path / "processed"
+    return RawPreprocessor(raw_json=str(raw), out_dir=str(out))
+
+
+def test_get_target_priority():
+    line = RawPreprocessor._process_line(nq_line(yes_no_answer="YES"))
+    assert RawPreprocessor._get_target(line)[0] == "yes"
+
+    line = RawPreprocessor._process_line(nq_line())
+    label, s, e = RawPreprocessor._get_target(line)
+    assert (label, s, e) == ("short", 2, 3)
+
+    line = RawPreprocessor._process_line(nq_line(short_answers=[]))
+    label, s, e = RawPreprocessor._get_target(line)
+    assert (label, s, e) == ("long", 1, 8)
+
+    line = RawPreprocessor._process_line(
+        nq_line(short_answers=[], candidate_index=-1, long_start=5, long_end=5)
+    )
+    label, s, e = RawPreprocessor._get_target(line)
+    assert (label, s, e) == ("unknown", -1, -1)
+    assert line["long_answer"] == "NONE"
+
+
+def test_preprocessor_end_to_end(tmp_path):
+    lines = [nq_line(example_id=str(i)) for i in range(20)]
+    prep = _prepare(tmp_path, lines)
+    labels_counter, labels, (tr_idx, tr_lab, te_idx, te_lab) = prep()
+
+    assert len(labels) == 20
+    assert labels_counter[RawPreprocessor.labels2id["short"]] == 20
+    assert len(tr_idx) + len(te_idx) == 20
+    assert len(te_idx) >= 1  # stratified split holds out at least one
+    assert (tmp_path / "processed" / "0.json").exists()
+
+    # second call loads from cache and returns an identical split
+    _, _, (tr2, _, te2, _) = prep()
+    np.testing.assert_array_equal(tr_idx, tr2)
+    np.testing.assert_array_equal(te_idx, te2)
+
+
+# -- chunking -----------------------------------------------------------------
+
+
+def test_drop_tags_and_encode(tmp_path):
+    tok = make_tokenizer(tmp_path)
+    text = "<P> london is the capital </P>"
+    token_ids, o2t, t2o, hist, word_i = drop_tags_and_encode(tok, text)
+    # 6 words, 4 real tokens (tags dropped)
+    assert len(o2t) == 6
+    assert len(token_ids) == 4
+    assert len(t2o) == 4
+    assert t2o == [1, 2, 3, 4]  # token -> word index (words 1..4 are real)
+    assert o2t[0] == 0 and o2t[1] == 0  # tag maps to next real token
+    assert word_i == 5
+    assert tok.decode(token_ids) == "london is the capital"
+
+
+def test_window_chunks_labels_and_sampling(tmp_path):
+    tok = make_tokenizer(tmp_path)
+    text = " ".join(["the"] * 100)
+    ids, o2t, t2o = (lambda r: (r[0], r[1], r[2]))(drop_tags_and_encode(tok, text))
+    # answer at tokens 10..12
+    records = window_chunks(
+        ids, ("short", 10, 12), question_len=5, max_seq_len=30, doc_stride=11
+    )
+    # document_len = 30-5-3 = 22
+    assert all(len(r.token_ids) <= 22 for r in records)
+    labelled = [r for r in records if r.label == "short"]
+    assert labelled, "at least one window must contain the answer"
+    for r in labelled:
+        # start/end mapped into final input coordinates (qlen + 2 offset)
+        assert r.start == 10 - r.doc_start + 7
+        assert r.end == 12 - r.doc_start + 7
+    unlabelled = [r for r in records if r.label == "unknown"]
+    assert all(r.start == -1 and r.end == -1 for r in unlabelled)
+
+
+def test_window_chunks_first_only(tmp_path):
+    tok = make_tokenizer(tmp_path)
+    ids = tok.encode(" ".join(["the"] * 100))
+    records = window_chunks(
+        ids, ("short", 0, 1), question_len=5, max_seq_len=30, doc_stride=11, first_only=True
+    )
+    assert len(records) == 1
+
+
+def test_sentence_chunks_rolling_window():
+    # synthetic "sentences" of token ids; window budget small
+    t_sens = [[1, 2, 3], [4, 5, 6], [7, 8, 9], [10, 11, 12]]
+    # max_seq_len 14, question_len 3 -> document_len = 8
+    records = sentence_chunks(t_sens, ("short", 4, 5), question_len=3, max_seq_len=14)
+    assert records, "must emit chunks"
+    # all chunks fit the window
+    assert all(len(r.token_ids) <= 8 for r in records)
+    # full coverage: last chunk is the tail
+    assert records[-1].doc_end == 12
+    # the chunk containing tokens 4..5 carries the label
+    labelled = [r for r in records if r.label == "short"]
+    assert labelled
+    for r in labelled:
+        assert r.doc_start <= 4 and 5 <= r.doc_end
+        assert r.start == 4 - r.doc_start + 5
+
+
+def test_truncate_record():
+    from ml_recipe_tpu.data.chunking import ChunkRecord
+
+    # answer beyond the cut: re-anchor at answer start
+    rec = ChunkRecord(
+        token_ids=list(range(40)), start=30 + 5, end=33 + 5, label="short",
+        doc_start=0, doc_end=40,
+    )
+    # question_len 3 -> document_len = 20 - 3 - 3 = 14, offset 5
+    out = truncate_record(rec, question_len=3, max_seq_len=20)
+    assert len(out.token_ids) == 10  # 40-30
+    assert out.start == 5
+    assert out.end == 5 + 3
+    assert out.token_ids[0] == 30
+
+    # answer inside the cut: plain tail cut
+    rec2 = ChunkRecord(
+        token_ids=list(range(40)), start=5, end=7, label="short", doc_start=0, doc_end=40
+    )
+    out2 = truncate_record(rec2, question_len=3, max_seq_len=20)
+    assert len(out2.token_ids) == 14
+    assert out2.start == 5 and out2.end == 7
+
+
+def test_split_sentences():
+    text = "London is big. Big Ben was built in 1859! Was it? Yes."
+    sens = split_sentences(text)
+    assert len(sens) == 4
+    assert sens[0] == "London is big."
+    # abbreviation guard
+    sens2 = split_sentences("Dr. Smith lives in London. He is fine.")
+    assert len(sens2) == 2
+    assert sens2[0] == "Dr. Smith lives in London."
+
+
+# -- datasets -----------------------------------------------------------------
+
+
+def _make_split_dataset(tmp_path, **kwargs):
+    tok = make_tokenizer(tmp_path)
+    lines = [nq_line(example_id=str(i)) for i in range(8)]
+    prep = _prepare(tmp_path, lines)
+    _, _, (tr_idx, _, te_idx, _) = prep()
+    ds = SplitDataset(
+        tmp_path / "processed",
+        tok,
+        tr_idx,
+        max_seq_len=64,
+        max_question_len=16,
+        doc_stride=8,
+        rng=np.random.default_rng(0),
+        **kwargs,
+    )
+    return ds, tok, tr_idx
+
+
+def test_split_dataset_item(tmp_path):
+    ds, tok, _ = _make_split_dataset(tmp_path)
+    item = ds[0]
+    assert item.input_ids[0] == tok.cls_token_id
+    assert item.input_ids[-1] == tok.sep_token_id
+    assert len(item.input_ids) <= 64
+    assert -1 <= item.start_id <= 64
+    assert item.label_id in range(5)
+    if item.start_id >= 0:
+        assert item.start_id <= item.end_id
+        assert item.start_position == item.start_id / 64
+
+
+def test_split_dataset_sentence_mode(tmp_path):
+    ds, tok, _ = _make_split_dataset(tmp_path, split_by_sentence=True, truncate=True)
+    item = ds[0]
+    assert len(item.input_ids) <= 64
+    assert item.input_ids[0] == tok.cls_token_id
+
+
+def test_chunk_dataset_returns_all_chunks(tmp_path):
+    tok = make_tokenizer(tmp_path)
+    lines = [nq_line(example_id=str(i)) for i in range(4)]
+    prep = _prepare(tmp_path, lines)
+    _, _, (tr_idx, _, _, _) = prep()
+    ds = ChunkDataset(
+        tmp_path / "processed", tok, tr_idx, max_seq_len=40, max_question_len=8, doc_stride=8
+    )
+    chunks = ds[0]
+    assert len(chunks) > 1  # long doc -> several windows
+    assert len({c.item_id for c in chunks}) == 1
+    labelled = [c for c in chunks if c.label_id != RawPreprocessor.labels2id["unknown"]]
+    assert labelled, "some chunk must contain the answer"
+    for c in chunks:
+        assert c.true_label == RawPreprocessor.labels2id["short"]
+        assert c.t2o  # provenance map present
+
+
+def test_dummy_dataset(tmp_path):
+    tok = make_tokenizer(tmp_path)
+    ds = DummyDataset(
+        tokenizer=tok, max_seq_len=32, max_question_len=8, dataset_len=100,
+        rng=np.random.default_rng(0),
+    )
+    assert len(ds) == 100
+    item = ds[0]
+    assert len(item.input_ids) == 32
+    assert item.start_id == 0 and item.end_id == 31
+    # special ids scrubbed from the random body
+    body = item.input_ids[1:9] + item.input_ids[10:-1]
+    assert tok.cls_token_id not in body
+    assert tok.sep_token_id not in body
+    assert tok.pad_token_id not in body
+
+
+# -- collate ------------------------------------------------------------------
+
+
+def test_collate_fixed_shape(tmp_path):
+    tok = make_tokenizer(tmp_path)
+    ds = DummyDataset(tokenizer=tok, max_seq_len=32, max_question_len=8,
+                      rng=np.random.default_rng(0))
+    items = [ds[i] for i in range(4)]
+    # shrink one item to exercise padding
+    items[0].input_ids = items[0].input_ids[:20]
+    inputs, labels = collate_fun(items, tok, max_seq_len=48)
+
+    assert inputs["input_ids"].shape == (4, 48)
+    assert inputs["attention_mask"].shape == (4, 48)
+    assert inputs["token_type_ids"].shape == (4, 48)
+    assert inputs["attention_mask"][0].sum() == 20
+    assert inputs["attention_mask"][1].sum() == 32
+    assert (inputs["input_ids"][0, 20:] == tok.pad_token_id).all()
+    # token_type: 0 through first SEP, 1 after (within true length)
+    row = items[1].input_ids
+    sep_pos = row.index(tok.sep_token_id)
+    assert (inputs["token_type_ids"][1, : sep_pos + 1] == 0).all()
+    assert (inputs["token_type_ids"][1, sep_pos + 1 : 32] == 1).all()
+
+    assert labels["cls"].shape == (4,)
+    assert labels["start_reg"].dtype == np.float32
+
+
+def test_collate_return_items(tmp_path):
+    tok = make_tokenizer(tmp_path)
+    ds = DummyDataset(tokenizer=tok, max_seq_len=32, max_question_len=8,
+                      rng=np.random.default_rng(0))
+    items = [ds[i] for i in range(2)]
+    out = make_collate_fun(tok, max_seq_len=32, return_items=True)(items)
+    assert len(out) == 3
+    assert out[2] is items
+
+
+# -- samplers / loaders -------------------------------------------------------
+
+
+def test_sharded_sampler_partitions_global_batch():
+    per_host = []
+    for host in range(4):
+        s = ShardedBatchSampler(
+            100, 8, process_index=host, process_count=4, shuffle=True, seed=1
+        )
+        per_host.append(list(s(epoch=0)))
+
+    n_batches = len(per_host[0])
+    assert n_batches == 100 // 8
+    for b in range(n_batches):
+        union = np.concatenate([per_host[h][b] for h in range(4)])
+        assert len(union) == 8
+        assert len(set(union.tolist())) == 8  # disjoint shards
+
+    # deterministic across re-iteration, different across epochs
+    s0 = ShardedBatchSampler(100, 8, process_index=0, process_count=4, seed=1)
+    np.testing.assert_array_equal(
+        np.concatenate(list(s0(0))), np.concatenate(list(s0(0)))
+    )
+    assert not np.array_equal(np.concatenate(list(s0(0))), np.concatenate(list(s0(1))))
+
+
+def test_weighted_sampler_oversamples():
+    w = np.zeros(100)
+    w[:10] = 1.0  # only first ten indices have weight
+    s = ShardedBatchSampler(100, 10, weights=w, seed=0)
+    idx = np.concatenate(list(s(0)))
+    assert set(idx.tolist()).issubset(set(range(10)))
+
+
+def test_dataloader_end_to_end(tmp_path):
+    tok = make_tokenizer(tmp_path)
+    ds = DummyDataset(tokenizer=tok, max_seq_len=32, max_question_len=8, dataset_len=40,
+                      rng=np.random.default_rng(0))
+    sampler = ShardedBatchSampler(40, 8, seed=0)
+    loader = DataLoader(ds, sampler, make_collate_fun(tok, max_seq_len=32), n_jobs=2)
+    batches = list(loader)
+    assert len(batches) == 5
+    for inputs, labels in batches:
+        assert inputs["input_ids"].shape == (8, 32)
+
+
+def test_list_dataloader_rebatches(tmp_path):
+    tok = make_tokenizer(tmp_path)
+    lines = [nq_line(example_id=str(i)) for i in range(6)]
+    prep = _prepare(tmp_path, lines)
+    _, _, (tr_idx, _, _, _) = prep()
+    ds = ChunkDataset(
+        tmp_path / "processed", tok, tr_idx, max_seq_len=40, max_question_len=8, doc_stride=8
+    )
+    loader = ListDataloader(ds, batch_size=4, n_jobs=2, buffer_size=64)
+    chunks_direct = sum(len(ds[i]) for i in range(len(ds)))
+    seen = 0
+    for batch in loader:
+        assert len(batch) <= 4
+        seen += len(batch)
+    assert seen == chunks_direct

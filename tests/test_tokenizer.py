@@ -1,0 +1,97 @@
+import json
+
+import pytest
+
+from ml_recipe_tpu.tokenizer import ByteLevelBPETokenizer, Tokenizer, WordPieceTokenizer
+
+from helpers import write_vocab
+
+
+def test_wordpiece_basic(tmp_path):
+    tok = WordPieceTokenizer(str(write_vocab(tmp_path)), lowercase=True)
+    assert tok.tokenize("The quick brown fox") == ["the", "quick", "brown", "fox"]
+    # continuation pieces
+    assert tok.tokenize("jumps") == ["jumps"] or "##s" in tok.tokenize("jumps")
+    # punctuation split
+    assert tok.tokenize("fox.") == ["fox", "."]
+    # unknown word
+    assert tok.tokenize("zzzqqq") == ["[UNK]"]
+
+
+def test_wordpiece_subword_merge(tmp_path):
+    tok = WordPieceTokenizer(str(write_vocab(tmp_path)), lowercase=True)
+    # 'unknowns' is not in vocab as a whole word: un + ##known + ##s
+    assert tok.tokenize("unknowns") == ["un", "##known", "##s"]
+
+
+def test_wordpiece_encode_decode_roundtrip(tmp_path):
+    tok = WordPieceTokenizer(str(write_vocab(tmp_path)), lowercase=True)
+    ids = tok.encode("the quick unknowns")
+    assert all(isinstance(i, int) for i in ids)
+    assert tok.decode(ids) == "the quick unknowns"
+
+
+def test_wordpiece_accent_stripping(tmp_path):
+    tok = WordPieceTokenizer(str(write_vocab(tmp_path)), lowercase=True)
+    assert tok.tokenize("Thé") == ["the"]
+
+
+def test_facade_bert(tmp_path):
+    tok = Tokenizer("bert", str(write_vocab(tmp_path)), lowercase=True)
+    assert tok.pad_token_id == 0
+    assert tok.unk_token_id == 1
+    assert tok.cls_token_id == 2
+    assert tok.sep_token_id == 3
+    assert len(tok) > 5
+    ids = tok.encode("the quick fox")
+    assert tok.cls_token_id not in ids  # encode adds NO special tokens
+    assert tok.decode([tok.cls_token_id] + ids + [tok.sep_token_id]) == "the quick fox"
+
+
+def test_facade_roberta_requires_merges(tmp_path):
+    with pytest.raises(AttributeError):
+        Tokenizer("roberta", "vocab.json")
+
+
+def _write_bpe_files(tmp_path):
+    # byte-level: 'h','e','l','o',' h' are mapped through bytes_to_unicode;
+    # ascii letters map to themselves, space maps to 'Ġ'
+    vocab = {
+        "<pad>": 0, "<s>": 1, "</s>": 2, "<unk>": 3,
+        "h": 4, "e": 5, "l": 6, "o": 7, "Ġ": 8,
+        "he": 9, "ll": 10, "hell": 11, "hello": 12, "Ġhello": 13,
+    }
+    vocab_file = tmp_path / "vocab.json"
+    vocab_file.write_text(json.dumps(vocab))
+    merges_file = tmp_path / "merges.txt"
+    merges_file.write_text("#version: 0.2\nh e\nl l\nhe ll\nhell o\nĠ hello\n")
+    return str(vocab_file), str(merges_file)
+
+
+def test_byte_level_bpe(tmp_path):
+    vocab_file, merges_file = _write_bpe_files(tmp_path)
+    tok = ByteLevelBPETokenizer(vocab_file, merges_file)
+    ids = tok.encode("hello hello")
+    assert ids == [12, 13]
+    assert tok.decode(ids) == "hello hello"
+
+
+def test_bpe_dropout_changes_segmentation(tmp_path):
+    import numpy as np
+
+    vocab_file, merges_file = _write_bpe_files(tmp_path)
+    tok = ByteLevelBPETokenizer(
+        vocab_file, merges_file, dropout=0.9, rng=np.random.default_rng(0)
+    )
+    # with heavy dropout, 'hello' should (almost always) stay split
+    pieces = tok.tokenize("hello")
+    assert len(pieces) > 1
+
+
+def test_facade_roberta(tmp_path):
+    vocab_file, merges_file = _write_bpe_files(tmp_path)
+    tok = Tokenizer("roberta", vocab_file, merges_file=merges_file)
+    assert tok.pad_token == "<pad>"
+    assert tok.pad_token_id == 0
+    assert tok.cls_token_id == 1
+    assert tok.encode("hello") == [12]
