@@ -1,0 +1,19 @@
+from .losses import (
+    cross_entropy_with_ignore,
+    label_smoothing_loss,
+    binary_focal_loss,
+    focal_loss,
+    mse_loss,
+    WeightedLoss,
+    build_loss,
+)
+
+__all__ = [
+    "cross_entropy_with_ignore",
+    "label_smoothing_loss",
+    "binary_focal_loss",
+    "focal_loss",
+    "mse_loss",
+    "WeightedLoss",
+    "build_loss",
+]

@@ -1,0 +1,198 @@
+"""Loss zoo — pure-JAX, jit-safe.
+
+Parity targets (reference ``modules/model/model/loss.py`` semantics, checked
+numerically against torch in tests):
+
+- ``cross_entropy_with_ignore``: ``nn.CrossEntropyLoss(ignore_index=-1)`` as
+  used for span start/end heads (init.py:34-35) — mean over non-ignored rows;
+  optional per-class weights reproduce ``CrossEntropyLoss(weight=...)``
+  (init.py:23) including its weighted-mean denominator.
+- ``label_smoothing_loss``: ``LabelSmoothingLossWithLogits`` (loss.py:5-38) —
+  KLDiv-batchmean against the smoothed target distribution when smoothing>0
+  (smoothing mass split over ``n_classes - num_ignore``), NLL otherwise.
+- ``binary_focal_loss``: ``BinaryFocalLossWithLogits`` (loss.py:41-54).
+- ``focal_loss``: ``FocalLossWithLogits`` (loss.py:57-71) — focal reweighting
+  applied *inside* the NLL pick, with ignore-index masking.
+- ``mse_loss``: ``nn.MSELoss`` for the position regressors (init.py:36-37).
+- ``WeightedLoss``: the per-head aggregator (loss.py:74-106). Functional
+  twist: instead of mutating AverageMeters inside the loss (impossible under
+  jit), ``__call__`` returns ``(total, per_head_values)`` and the trainer
+  feeds meters host-side.
+
+All losses take f32 logits (the model promotes) and integer/float targets.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _log_softmax(logits):
+    return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+
+def cross_entropy_with_ignore(
+    logits: jnp.ndarray,
+    targets: jnp.ndarray,
+    *,
+    ignore_index: int = -1,
+    class_weights: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Mean NLL over rows whose target != ignore_index.
+
+    With ``class_weights`` the mean is weighted by the target's class weight
+    (torch ``CrossEntropyLoss(weight=...)`` denominator semantics).
+    """
+    log_probs = _log_softmax(logits)
+    valid = targets != ignore_index
+    safe_targets = jnp.where(valid, targets, 0)
+
+    nll = -jnp.take_along_axis(log_probs, safe_targets[..., None], axis=-1)[..., 0]
+
+    if class_weights is not None:
+        w = class_weights[safe_targets] * valid
+        return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1e-12)
+
+    valid_f = valid.astype(jnp.float32)
+    return jnp.sum(nll * valid_f) / jnp.maximum(jnp.sum(valid_f), 1.0)
+
+
+def label_smoothing_loss(
+    logits: jnp.ndarray,
+    targets: jnp.ndarray,
+    *,
+    n_classes: int,
+    smoothing: float = 0.0,
+    ignore_index: int = -100,
+) -> jnp.ndarray:
+    assert 0 <= smoothing <= 1
+    log_probs = _log_softmax(logits)
+
+    if smoothing <= 0:
+        return cross_entropy_with_ignore(logits, targets, ignore_index=ignore_index)
+
+    num_ignore = 1 + (0 <= ignore_index < n_classes)
+    fill_value = smoothing / (n_classes - num_ignore)
+    confidence = 1.0 - smoothing
+
+    target_dist = jnp.full((targets.shape[0], n_classes), fill_value, dtype=jnp.float32)
+    target_dist = jnp.asarray(target_dist).at[
+        jnp.arange(targets.shape[0]), targets
+    ].set(confidence)
+    if 0 <= ignore_index < n_classes:
+        target_dist = target_dist.at[:, ignore_index].set(0.0)
+
+    # KLDivLoss(reduction='batchmean'): sum over classes of t*(log t - log p),
+    # averaged over the batch; 0*log(0) := 0.
+    t_log_t = jnp.where(target_dist > 0, target_dist * jnp.log(target_dist), 0.0)
+    kl = jnp.sum(t_log_t - target_dist * log_probs, axis=-1)
+    return jnp.mean(kl)
+
+
+def binary_focal_loss(
+    logits: jnp.ndarray, targets: jnp.ndarray, *, alpha: float = 1.0, gamma: float = 2.0
+) -> jnp.ndarray:
+    logits = logits.astype(jnp.float32)
+    targets = targets.astype(jnp.float32)
+    # stable BCE-with-logits
+    bce = jnp.maximum(logits, 0) - logits * targets + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    probs = jnp.exp(-bce)
+    return jnp.mean(alpha * (1 - probs) ** gamma * bce)
+
+
+def focal_loss(
+    logits: jnp.ndarray,
+    targets: jnp.ndarray,
+    *,
+    alpha: float = 1.0,
+    gamma: float = 2.0,
+    ignore_index: int = -1,
+) -> jnp.ndarray:
+    log_probs = _log_softmax(logits)
+    probs = jnp.exp(log_probs)
+    weighted = alpha * (1 - probs) ** gamma * log_probs
+
+    valid = targets != ignore_index
+    safe_targets = jnp.where(valid, targets, 0)
+    picked = -jnp.take_along_axis(weighted, safe_targets[..., None], axis=-1)[..., 0]
+
+    valid_f = valid.astype(jnp.float32)
+    return jnp.sum(picked * valid_f) / jnp.maximum(jnp.sum(valid_f), 1.0)
+
+
+def mse_loss(preds: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    return jnp.mean((preds.astype(jnp.float32) - targets.astype(jnp.float32)) ** 2)
+
+
+class WeightedLoss:
+    """Weighted sum of per-head losses (reference loss.py:74-106).
+
+    ``losses`` maps head name -> (loss_fn, weight). ``__call__`` returns
+    ``(total_loss, {head: value})``; per-head values are the *unweighted*
+    losses, matching what the reference logged into its meters.
+    """
+
+    def __init__(self, losses: Dict[str, Tuple[Callable, float]]):
+        self._losses = losses
+
+    @property
+    def keys(self):
+        return self._losses.keys()
+
+    def __call__(self, preds: dict, targets: dict) -> Tuple[jnp.ndarray, dict]:
+        assert set(preds.keys()) >= set(self._losses.keys())
+        assert set(targets.keys()) >= set(self._losses.keys())
+
+        values = {}
+        full_loss = 0.0
+        for key, (loss_f, weight) in self._losses.items():
+            loss = loss_f(preds[key], targets[key])
+            values[key] = loss
+            full_loss = full_loss + weight * loss
+
+        values["loss"] = full_loss
+        return full_loss, values
+
+
+def build_loss(params, train_weights: Optional[dict] = None) -> WeightedLoss:
+    """Select the classification loss + per-head weights (init.py:18-40)."""
+    import functools
+
+    label_weights = None
+    if train_weights is not None and train_weights.get("label_weights") is not None:
+        label_weights = jnp.asarray(train_weights["label_weights"], dtype=jnp.float32)
+
+    n_classes = 5
+    if params.loss == "ce":
+        class_loss = functools.partial(
+            cross_entropy_with_ignore, ignore_index=-100, class_weights=label_weights
+        )
+    elif params.loss == "focal":
+        class_loss = functools.partial(
+            focal_loss, alpha=params.focal_alpha, gamma=params.focal_gamma,
+            ignore_index=-100,
+        )
+    elif params.loss == "smooth":
+        class_loss = functools.partial(
+            label_smoothing_loss, n_classes=n_classes, smoothing=params.smooth_alpha
+        )
+    else:
+        raise NotImplementedError(f"Unknown loss {params.loss}")
+
+    def _wght(name):
+        return getattr(params, name, 1)
+
+    span_ce = functools.partial(cross_entropy_with_ignore, ignore_index=-1)
+
+    return WeightedLoss(
+        {
+            "start_class": (span_ce, _wght("w_start")),
+            "end_class": (span_ce, _wght("w_end")),
+            "start_reg": (mse_loss, _wght("w_start_reg")),
+            "end_reg": (mse_loss, _wght("w_end_reg")),
+            "cls": (class_loss, _wght("w_cls")),
+        }
+    )

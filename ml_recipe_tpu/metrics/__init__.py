@@ -1,0 +1,9 @@
+from .meters import AverageMeter, APMeter, MAPMeter, average_precision, accuracy_score
+
+__all__ = [
+    "AverageMeter",
+    "APMeter",
+    "MAPMeter",
+    "average_precision",
+    "accuracy_score",
+]

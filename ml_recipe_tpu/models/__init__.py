@@ -1,0 +1,12 @@
+from .config import EncoderConfig, MODEL_PRESETS, resolve_model_config
+from .qa_model import QAModel, QA_OUTPUT_KEYS
+from .encoder import TransformerEncoder
+
+__all__ = [
+    "EncoderConfig",
+    "MODEL_PRESETS",
+    "resolve_model_config",
+    "QAModel",
+    "QA_OUTPUT_KEYS",
+    "TransformerEncoder",
+]

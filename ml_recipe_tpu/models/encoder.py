@@ -1,0 +1,161 @@
+"""First-party Flax BERT/RoBERTa encoder.
+
+Replaces the HF ``BertModel``/``RobertaModel`` trunk the reference loads in
+``modules/model/model/model.py:20-25``. Architecture is the standard
+post-layer-norm BERT stack; differences from a naive port are TPU-driven:
+
+- activations run in ``cfg.dtype`` (bf16 by default) while params stay f32 —
+  the native replacement for Apex AMP (reference trainer.py:128-133);
+- attention goes through ``ops.dot_product_attention`` so the Pallas flash
+  kernel can be swapped in without touching the module;
+- optional per-layer rematerialisation (``jax.checkpoint``) trades FLOPs for
+  HBM on long-sequence configs;
+- no data-dependent Python control flow — the whole forward is one traced
+  XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.attention import dot_product_attention
+from .config import EncoderConfig
+
+
+class Embeddings(nn.Module):
+    cfg: EncoderConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids, *, deterministic: bool):
+        cfg = self.cfg
+
+        word = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="word_embeddings",
+                        dtype=self.dtype)(input_ids)
+
+        positions = jnp.arange(input_ids.shape[-1], dtype=jnp.int32) + cfg.position_offset
+        pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                       name="position_embeddings", dtype=self.dtype)(positions)[None, :, :]
+
+        if cfg.type_vocab_size > 1:
+            typ = nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                           name="token_type_embeddings", dtype=self.dtype)(token_type_ids)
+        else:
+            # RoBERTa has a single segment type; keep the param for checkpoint
+            # parity but index it with zeros.
+            typ = nn.Embed(1, cfg.hidden_size, name="token_type_embeddings",
+                           dtype=self.dtype)(jnp.zeros_like(token_type_ids))
+
+        x = word + pos + typ
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="layer_norm", dtype=self.dtype)(x)
+        x = nn.Dropout(cfg.hidden_dropout_prob)(x, deterministic=deterministic)
+        return x
+
+
+class SelfAttention(nn.Module):
+    cfg: EncoderConfig
+    dtype: jnp.dtype = jnp.float32
+    attention_impl: str = "xla"
+
+    @nn.compact
+    def __call__(self, hidden, mask, *, deterministic: bool):
+        cfg = self.cfg
+        B, L, H = hidden.shape
+
+        def heads(name):
+            y = nn.Dense(cfg.hidden_size, name=name, dtype=self.dtype)(hidden)
+            return y.reshape(B, L, cfg.num_heads, cfg.head_dim)
+
+        q, k, v = heads("query"), heads("key"), heads("value")
+
+        dropout_rng = None
+        if not deterministic and cfg.attention_probs_dropout_prob > 0:
+            dropout_rng = self.make_rng("dropout")
+
+        ctx = dot_product_attention(
+            q, k, v, mask,
+            dropout_rate=0.0 if deterministic else cfg.attention_probs_dropout_prob,
+            dropout_rng=dropout_rng,
+            dtype=self.dtype,
+            impl=self.attention_impl,
+        )
+        ctx = ctx.reshape(B, L, cfg.hidden_size)
+
+        out = nn.Dense(cfg.hidden_size, name="output", dtype=self.dtype)(ctx)
+        out = nn.Dropout(cfg.hidden_dropout_prob)(out, deterministic=deterministic)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="layer_norm",
+                            dtype=self.dtype)(hidden + out)
+
+
+class FeedForward(nn.Module):
+    cfg: EncoderConfig
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, hidden, *, deterministic: bool):
+        cfg = self.cfg
+        y = nn.Dense(cfg.intermediate_size, name="intermediate", dtype=self.dtype)(hidden)
+        y = nn.gelu(y, approximate=False)
+        y = nn.Dense(cfg.hidden_size, name="output", dtype=self.dtype)(y)
+        y = nn.Dropout(cfg.hidden_dropout_prob)(y, deterministic=deterministic)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="layer_norm",
+                            dtype=self.dtype)(hidden + y)
+
+
+class EncoderLayer(nn.Module):
+    cfg: EncoderConfig
+    dtype: jnp.dtype = jnp.float32
+    attention_impl: str = "xla"
+
+    @nn.compact
+    def __call__(self, hidden, mask, deterministic: bool = True):
+        hidden = SelfAttention(self.cfg, self.dtype, self.attention_impl,
+                               name="attention")(hidden, mask, deterministic=deterministic)
+        hidden = FeedForward(self.cfg, self.dtype, name="mlp")(
+            hidden, deterministic=deterministic
+        )
+        return hidden
+
+
+class TransformerEncoder(nn.Module):
+    """BERT/RoBERTa trunk: returns (sequence_output, pooled_output)."""
+
+    cfg: EncoderConfig
+    dtype: jnp.dtype = jnp.float32
+    attention_impl: str = "xla"
+    remat: bool = False
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids,
+        attention_mask: Optional[jnp.ndarray] = None,
+        token_type_ids: Optional[jnp.ndarray] = None,
+        *,
+        deterministic: bool = True,
+    ):
+        cfg = self.cfg
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+
+        hidden = Embeddings(cfg, self.dtype, name="embeddings")(
+            input_ids, token_type_ids, deterministic=deterministic
+        )
+
+        layer_cls = EncoderLayer
+        if self.remat:
+            layer_cls = nn.remat(EncoderLayer, static_argnums=(3,))
+
+        for i in range(cfg.num_layers):
+            hidden = layer_cls(cfg, self.dtype, self.attention_impl,
+                               name=f"layer_{i}")(hidden, attention_mask, deterministic)
+
+        pooled = nn.Dense(cfg.hidden_size, name="pooler", dtype=self.dtype)(hidden[:, 0])
+        pooled = jnp.tanh(pooled)
+
+        return hidden, pooled

@@ -1,0 +1,128 @@
+"""HF checkpoint -> first-party Flax parameter conversion.
+
+The reference warm-starts from HF ``from_pretrained``
+(``modules/model/model/model.py:20-25``). Our encoder is first-party, so this
+module maps an HF BERT/RoBERTa ``state_dict`` (torch ``pytorch_model.bin``, a
+``safetensors`` file, or an in-memory dict) onto the
+:class:`~ml_recipe_tpu.models.encoder.TransformerEncoder` parameter tree.
+Runs offline — no network access is attempted unless the caller passes a hub
+name that is already cached.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Dict
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def _to_numpy(t) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t
+    return t.detach().cpu().numpy()  # torch tensor
+
+
+def load_hf_state_dict(path_or_name: str) -> Dict[str, np.ndarray]:
+    """Load an HF torch state_dict from a local file/dir (or cached hub name)."""
+    candidates = []
+    if os.path.isdir(path_or_name):
+        candidates = [
+            os.path.join(path_or_name, "model.safetensors"),
+            os.path.join(path_or_name, "pytorch_model.bin"),
+        ]
+    elif os.path.isfile(path_or_name):
+        candidates = [path_or_name]
+
+    for cand in candidates:
+        if not os.path.exists(cand):
+            continue
+        if cand.endswith(".safetensors"):
+            from safetensors.numpy import load_file
+
+            return dict(load_file(cand))
+        import torch
+
+        sd = torch.load(cand, map_location="cpu", weights_only=True)
+        return {k: _to_numpy(v) for k, v in sd.items()}
+
+    # Fall back to transformers (uses its local cache; requires the weights
+    # to already be present when running without egress).
+    from transformers import AutoModel
+
+    model = AutoModel.from_pretrained(path_or_name)
+    return {k: _to_numpy(v) for k, v in model.state_dict().items()}
+
+
+def _strip_prefix(sd: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Drop a leading ``bert.``/``roberta.`` wrapper prefix if present."""
+    for prefix in ("bert.", "roberta."):
+        if any(k.startswith(prefix + "embeddings.") for k in sd):
+            return {k[len(prefix):]: v for k, v in sd.items() if k.startswith(prefix)}
+    return sd
+
+
+def hf_to_encoder_params(state_dict: Dict[str, np.ndarray], num_layers: int) -> dict:
+    """Map HF BertModel/RobertaModel names onto our encoder param tree."""
+    sd = _strip_prefix(state_dict)
+
+    def dense(prefix: str) -> dict:
+        return {
+            "kernel": sd[f"{prefix}.weight"].T.copy(),
+            "bias": sd[f"{prefix}.bias"].copy(),
+        }
+
+    def layer_norm(prefix: str) -> dict:
+        return {
+            "scale": sd[f"{prefix}.weight"].copy(),
+            "bias": sd[f"{prefix}.bias"].copy(),
+        }
+
+    params = {
+        "embeddings": {
+            "word_embeddings": {"embedding": sd["embeddings.word_embeddings.weight"].copy()},
+            "position_embeddings": {
+                "embedding": sd["embeddings.position_embeddings.weight"].copy()
+            },
+            "token_type_embeddings": {
+                "embedding": sd["embeddings.token_type_embeddings.weight"].copy()
+            },
+            "layer_norm": layer_norm("embeddings.LayerNorm"),
+        },
+        "pooler": dense("pooler.dense"),
+    }
+
+    for i in range(num_layers):
+        hf = f"encoder.layer.{i}"
+        params[f"layer_{i}"] = {
+            "attention": {
+                "query": dense(f"{hf}.attention.self.query"),
+                "key": dense(f"{hf}.attention.self.key"),
+                "value": dense(f"{hf}.attention.self.value"),
+                "output": dense(f"{hf}.attention.output.dense"),
+                "layer_norm": layer_norm(f"{hf}.attention.output.LayerNorm"),
+            },
+            "mlp": {
+                "intermediate": dense(f"{hf}.intermediate.dense"),
+                "output": dense(f"{hf}.output.dense"),
+                "layer_norm": layer_norm(f"{hf}.output.LayerNorm"),
+            },
+        }
+
+    return params
+
+
+def load_pretrained_into(params: dict, path_or_name: str, num_layers: int) -> dict:
+    """Replace the ``transformer`` subtree of initialized QA-model params with
+    converted HF weights (heads stay freshly initialized, matching the
+    reference where only the trunk is pretrained)."""
+    sd = load_hf_state_dict(path_or_name)
+    encoder = hf_to_encoder_params(sd, num_layers)
+
+    new_params = dict(params)
+    new_params["transformer"] = encoder
+    logger.info(f"Encoder weights converted from {path_or_name}.")
+    return new_params

@@ -1,0 +1,91 @@
+"""Multi-head QA model.
+
+Parity target: reference ``modules/model/model/model.py:13-73``
+(``BertForQuestionAnswering``): encoder trunk + four heads —
+``position_outputs`` Linear(H,2) giving start/end span logits over tokens,
+``classifier`` Dropout+Linear(H,5) on the pooled output, and
+``reg_start``/``reg_end`` Linear(H,1)+Sigmoid normalized-position regressors.
+Forward returns the same dict contract with keys
+``start_class``/``end_class``/``start_reg``/``end_reg``/``cls``.
+
+TPU delta: span logits at padding positions are masked to a large negative
+value. The reference pads only to the per-batch max, so stray logits on pad
+positions rarely matter there; with static ``max_seq_len`` padding they would
+dominate argmax at inference, so masking restores the reference's effective
+behaviour under fixed shapes.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .config import EncoderConfig
+from .encoder import TransformerEncoder
+
+QA_OUTPUT_KEYS = ("start_class", "end_class", "start_reg", "end_reg", "cls")
+
+_MASK_NEG = -1e9
+
+
+class QAModel(nn.Module):
+    cfg: EncoderConfig
+    dtype: jnp.dtype = jnp.float32
+    attention_impl: str = "xla"
+    remat: bool = False
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids,
+        attention_mask=None,
+        token_type_ids=None,
+        *,
+        deterministic: bool = True,
+    ):
+        cfg = self.cfg
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+
+        sequence_output, pooled_output = TransformerEncoder(
+            cfg, self.dtype, self.attention_impl, self.remat, name="transformer"
+        )(
+            input_ids,
+            attention_mask=attention_mask,
+            token_type_ids=token_type_ids,
+            deterministic=deterministic,
+        )
+
+        # span start/end logits over token positions (model.py:30,54-58)
+        position_logits = nn.Dense(2, name="position_outputs", dtype=self.dtype)(
+            sequence_output
+        )
+        start_logits = position_logits[..., 0]
+        end_logits = position_logits[..., 1]
+
+        pad_penalty = (1 - attention_mask).astype(jnp.float32) * _MASK_NEG
+        start_logits = start_logits.astype(jnp.float32) + pad_penalty
+        end_logits = end_logits.astype(jnp.float32) + pad_penalty
+
+        # 5-class answer-type classification on pooled output (model.py:33-34,61)
+        cls_hidden = nn.Dropout(cfg.hidden_dropout_prob)(
+            pooled_output, deterministic=deterministic
+        )
+        classifier_logits = nn.Dense(cfg.num_labels, name="classifier",
+                                     dtype=self.dtype)(cls_hidden)
+
+        # normalized-position regressors (model.py:37-41,64-65)
+        reg_start = nn.sigmoid(
+            nn.Dense(1, name="reg_start", dtype=self.dtype)(pooled_output)
+        )[..., 0]
+        reg_end = nn.sigmoid(
+            nn.Dense(1, name="reg_end", dtype=self.dtype)(pooled_output)
+        )[..., 0]
+
+        return {
+            "start_class": start_logits,
+            "end_class": end_logits,
+            "start_reg": reg_start.astype(jnp.float32),
+            "end_reg": reg_end.astype(jnp.float32),
+            "cls": classifier_logits.astype(jnp.float32),
+        }

@@ -1,0 +1,82 @@
+"""Attention ops.
+
+The reference's attention lives inside HF BertModel CUDA kernels (SURVEY.md
+§2.2). Here it is a first-party op with two interchangeable implementations:
+
+- ``xla``: plain einsum softmax attention — XLA fuses it well and it runs on
+  any backend (used in tests on the CPU mesh).
+- ``pallas``: fused flash-attention TPU kernel (``ops.flash_attention``) that
+  never materialises the [B,H,L,L] score matrix in HBM.
+
+``dot_product_attention`` picks per the ``impl`` argument ('auto' = pallas on
+TPU when shapes qualify, else xla).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _xla_attention(
+    q: jnp.ndarray,  # [B, L, H, D]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: Optional[jnp.ndarray],  # [B, L] 1=real, 0=pad
+    *,
+    dropout_rate: float = 0.0,
+    dropout_rng=None,
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    depth = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(depth).astype(dtype)
+
+    # [B, H, Lq, Lk]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+
+    if mask is not None:
+        big_neg = jnp.finfo(jnp.float32).min
+        scores = jnp.where(mask[:, None, None, :] > 0, scores, big_neg)
+
+    # softmax in f32 for numerical stability regardless of compute dtype
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = probs * keep.astype(dtype) / (1.0 - dropout_rate)
+
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def dot_product_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    *,
+    dropout_rate: float = 0.0,
+    dropout_rng=None,
+    dtype=jnp.float32,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """Multi-head attention over [B, L, H, D] tensors with a [B, L] key mask."""
+    if impl == "auto":
+        use_pallas = (
+            jax.default_backend() == "tpu"
+            and dropout_rate == 0.0
+            and q.shape[1] % 128 == 0
+            and q.shape[-1] % 128 == 0
+        )
+        impl = "pallas" if use_pallas else "xla"
+
+    if impl == "pallas":
+        from .flash_attention import flash_attention
+
+        return flash_attention(q, k, v, mask, dtype=dtype)
+
+    return _xla_attention(
+        q, k, v, mask, dropout_rate=dropout_rate, dropout_rng=dropout_rng, dtype=dtype
+    )

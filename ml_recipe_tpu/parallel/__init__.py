@@ -1,0 +1,45 @@
+from .mesh import MeshSpec, build_mesh, local_device_count
+from .dist import (
+    initialize_distributed,
+    initialize_from_env,
+    barrier,
+    process_index,
+    process_count,
+    is_primary,
+)
+from .sharding import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    batch_pspec,
+    batch_sharding,
+    param_pspecs,
+    shard_params,
+    make_global_array,
+    TP_RULES,
+)
+from .collectives import pmean, psum_scalar, cross_replica_mean
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "local_device_count",
+    "initialize_distributed",
+    "initialize_from_env",
+    "barrier",
+    "process_index",
+    "process_count",
+    "is_primary",
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "SEQ_AXIS",
+    "batch_pspec",
+    "batch_sharding",
+    "param_pspecs",
+    "shard_params",
+    "make_global_array",
+    "TP_RULES",
+    "pmean",
+    "psum_scalar",
+    "cross_replica_mean",
+]

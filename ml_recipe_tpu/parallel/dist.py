@@ -1,0 +1,110 @@
+"""Multi-host bootstrap and synchronization.
+
+Parity target: the reference's NCCL process-group init + TCP rendezvous
+(train.py:27-28, ``tcp://MASTER_IP:9080`` parser.py:166-167) and its explicit
+barriers (train.py:55, trainer.py:319). TPU-native replacement:
+``jax.distributed.initialize`` (one process per HOST, not per device) driven
+by the same env-var contract the platform launcher exports
+(MASTER_IP/MASTER_PORT/WORLD_SIZE/LOCAL_RANK, reference .neuro/live.yml:126-132
+and scripts/worker.sh), and barriers via a tiny all-reduce across all devices.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def _strip_scheme(address: str) -> str:
+    for scheme in ("tcp://", "grpc://"):
+        if address.startswith(scheme):
+            return address[len(scheme):]
+    return address
+
+
+def initialize_distributed(
+    *,
+    coordinator_address: Optional[str] = None,
+    num_processes: int = 1,
+    process_id: int = 0,
+) -> None:
+    """Join the multi-host world. No-op for single-process runs (the
+    reference likewise skips init_process_group when world_size == 1,
+    train.py:135,141-148)."""
+    global _initialized
+    if num_processes <= 1 or _initialized:
+        return
+
+    address = _strip_scheme(coordinator_address or "127.0.0.1:9080")
+    logger.warning(
+        "It can take a while to start all worker processes and connect "
+        "to the coordinator."
+    )
+    jax.distributed.initialize(
+        coordinator_address=address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    logger.info(
+        f"Joined distributed world: process {process_id}/{num_processes}, "
+        f"coordinator {address}, {jax.device_count()} global devices."
+    )
+
+
+def initialize_from_params(params) -> None:
+    """Bootstrap from the trainer flags (reference names preserved)."""
+    local_rank = getattr(params, "local_rank", -1)
+    world_size = getattr(params, "dist_world_size", 1)
+    if world_size > 1 and local_rank < 0:
+        raise AttributeError("Specify local rank.")
+    initialize_distributed(
+        coordinator_address=getattr(params, "dist_init_method", None),
+        num_processes=world_size,
+        process_id=max(local_rank, 0),
+    )
+
+
+def initialize_from_env() -> None:
+    """Bootstrap from the platform launcher env contract
+    (MASTER_IP/MASTER_PORT/WORLD_SIZE/LOCAL_RANK)."""
+    world_size = int(os.environ.get("WORLD_SIZE", "1"))
+    if world_size <= 1:
+        return
+    master_ip = os.environ.get("MASTER_IP", "127.0.0.1")
+    master_port = os.environ.get("MASTER_PORT", "9080")
+    local_rank = int(os.environ.get("LOCAL_RANK", "0"))
+    initialize_distributed(
+        coordinator_address=f"{master_ip}:{master_port}",
+        num_processes=world_size,
+        process_id=local_rank,
+    )
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_primary() -> bool:
+    """Process 0 — the reference's ``local_rank in [-1, 0]`` gate."""
+    return jax.process_index() == 0
+
+
+def barrier(name: str = "barrier") -> None:
+    """Block until every process reaches this point (train.py:55 parity)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

@@ -1,0 +1,88 @@
+"""Device mesh construction.
+
+The reference's only topology concept is "world size × GPUs per node" for DDP
+(train.py:133-136). Here the topology is a named `jax.sharding.Mesh` with up
+to three axes:
+
+- ``data``  — data parallelism (replaces DDP; gradients psum over this axis)
+- ``model`` — tensor parallelism over attention heads / MLP width (no
+  reference counterpart; SURVEY.md §2.3 stretch)
+- ``seq``   — sequence/context parallelism for long inputs (ring attention)
+
+Axis sizes come from the ``--mesh`` flag ("data:4,model:2"); by default all
+visible devices form one data axis. Works identically on real TPU meshes and
+the virtual 8-CPU-device test mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+logger = logging.getLogger(__name__)
+
+AXIS_ORDER = ("data", "seq", "model")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    axes: Dict[str, int]
+
+    @classmethod
+    def from_string(cls, spec: Optional[str], n_devices: Optional[int] = None) -> "MeshSpec":
+        from ..config.parser import parse_mesh_spec
+
+        axes = parse_mesh_spec(spec)
+        if not axes:
+            axes = {"data": n_devices if n_devices is not None else len(jax.devices())}
+        return cls(axes=axes)
+
+    @property
+    def size(self) -> int:
+        return math.prod(self.axes.values())
+
+    def ordered(self) -> Dict[str, int]:
+        """Axes in canonical order (data outermost, model innermost so model
+        groups land on neighbouring devices — ICI-friendly)."""
+        out = {name: self.axes[name] for name in AXIS_ORDER if name in self.axes}
+        for name, size in self.axes.items():  # preserve any custom axes
+            if name not in out:
+                out[name] = size
+        return out
+
+
+def build_mesh(
+    spec: Optional[str] = None,
+    *,
+    devices: Optional[Sequence] = None,
+    axes: Optional[Dict[str, int]] = None,
+) -> Mesh:
+    """Build a Mesh from a spec string / axes dict over the given devices."""
+    devices = list(devices if devices is not None else jax.devices())
+
+    if axes is not None:
+        mesh_spec = MeshSpec(axes=dict(axes))
+    else:
+        mesh_spec = MeshSpec.from_string(spec, n_devices=len(devices))
+
+    ordered = mesh_spec.ordered()
+    if mesh_spec.size != len(devices):
+        raise ValueError(
+            f"Mesh axes {ordered} require {mesh_spec.size} devices, "
+            f"but {len(devices)} are visible."
+        )
+
+    device_array = np.asarray(devices).reshape(tuple(ordered.values()))
+    mesh = Mesh(device_array, axis_names=tuple(ordered.keys()))
+    logger.info(f"Built device mesh {dict(zip(mesh.axis_names, mesh.devices.shape))}.")
+    return mesh
+
+
+def local_device_count(mesh: Mesh) -> int:
+    return len([d for d in mesh.devices.flat if d.process_index == jax.process_index()])

@@ -1,0 +1,17 @@
+from .optim import build_optimizer, adamod, linear_warmup_schedule
+from .trainer import Trainer
+from .callbacks import TestCallback, AccuracyCallback, MAPCallback, SaveBestCallback
+from .checkpoint import save_checkpoint, load_checkpoint
+
+__all__ = [
+    "build_optimizer",
+    "adamod",
+    "linear_warmup_schedule",
+    "Trainer",
+    "TestCallback",
+    "AccuracyCallback",
+    "MAPCallback",
+    "SaveBestCallback",
+    "save_checkpoint",
+    "load_checkpoint",
+]

@@ -1,0 +1,235 @@
+"""Optimizers and LR schedule (optax).
+
+Parity targets:
+- HF ``AdamW(..., correct_bias=False)`` + no-decay param groups for
+  bias/LayerNorm (reference init.py:125-138): here one optax chain with a
+  decay mask over param paths.
+- ``AdaMod`` (reference trainer/optim.py:8-100, vendored from
+  lancopku/AdaMod): Adam moments plus an EMA bound on the per-parameter step
+  size — re-derived as an optax GradientTransformation.
+- ``get_linear_schedule_with_warmup`` (reference trainer.py:116-126): linear
+  0→lr over warmup, then linear decay to 0 at num_training_steps.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def linear_warmup_schedule(lr: float, num_warmup_steps: int, num_training_steps: int):
+    """LR(step): step/warmup * lr, then linear decay to 0 (HF semantics)."""
+
+    def schedule(step):
+        step = jnp.asarray(step, dtype=jnp.float32)
+        warm = jnp.maximum(num_warmup_steps, 1)
+        rise = step / warm
+        fall = jnp.maximum(
+            (num_training_steps - step)
+            / jnp.maximum(num_training_steps - num_warmup_steps, 1),
+            0.0,
+        )
+        return lr * jnp.where(step < num_warmup_steps, rise, fall)
+
+    return schedule
+
+
+class AdaModState(NamedTuple):
+    count: jnp.ndarray
+    exp_avg: optax.Updates
+    exp_avg_sq: optax.Updates
+    exp_avg_lr: optax.Updates
+
+
+def adamod(
+    learning_rate,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    beta3: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    mask=None,
+) -> optax.GradientTransformation:
+    """AdaMod: Adam with momental bounds on per-param learning rates.
+
+    Matches the reference implementation step-for-step (trainer/optim.py:73-98):
+    bias-corrected Adam step size per element, EMA-smoothed (beta3) upper
+    bound, decoupled weight decay applied as ``p -= wd * lr * p``.
+    """
+
+    def init_fn(params):
+        zeros = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)
+        return AdaModState(
+            count=jnp.zeros([], jnp.int32),
+            exp_avg=zeros(),
+            exp_avg_sq=zeros(),
+            exp_avg_lr=zeros(),
+        )
+
+    def update_fn(updates, state, params):
+        assert params is not None, "adamod requires params for weight decay"
+        count = state.count + 1
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+
+        exp_avg = jax.tree_util.tree_map(
+            lambda m, g: b1 * m + (1 - b1) * g, state.exp_avg, updates
+        )
+        exp_avg_sq = jax.tree_util.tree_map(
+            lambda v, g: b2 * v + (1 - b2) * g * g, state.exp_avg_sq, updates
+        )
+
+        bias1 = 1 - b1 ** count.astype(jnp.float32)
+        bias2 = 1 - b2 ** count.astype(jnp.float32)
+        step_scale = lr * jnp.sqrt(bias2) / bias1
+
+        def bounded_step(m, v, ema_lr, p):
+            denom = jnp.sqrt(v) + eps
+            step_size = step_scale / denom
+            new_ema_lr = beta3 * ema_lr + (1 - beta3) * step_size
+            step_size = jnp.minimum(step_size, new_ema_lr)
+            delta = -step_size * m
+            if weight_decay != 0:
+                delta = delta - weight_decay * lr * p
+            return delta, new_ema_lr
+
+        flat_m, treedef = jax.tree_util.tree_flatten(exp_avg)
+        flat_v = treedef.flatten_up_to(exp_avg_sq)
+        flat_e = treedef.flatten_up_to(state.exp_avg_lr)
+        flat_p = treedef.flatten_up_to(params)
+
+        deltas, new_emas = [], []
+        for m, v, e, p in zip(flat_m, flat_v, flat_e, flat_p):
+            d, ne = bounded_step(m, v, e, p)
+            deltas.append(d)
+            new_emas.append(ne)
+
+        new_updates = jax.tree_util.tree_unflatten(treedef, deltas)
+        new_ema_lr = jax.tree_util.tree_unflatten(treedef, new_emas)
+
+        return new_updates, AdaModState(
+            count=count, exp_avg=exp_avg, exp_avg_sq=exp_avg_sq, exp_avg_lr=new_ema_lr
+        )
+
+    tx = optax.GradientTransformation(init_fn, update_fn)
+    if mask is not None:
+        tx = optax.masked(tx, mask)
+    return tx
+
+
+def _scale_by_adam_no_bias_correction(
+    b1: float = 0.9, b2: float = 0.999, eps: float = 1e-6
+) -> optax.GradientTransformation:
+    """Adam moments WITHOUT bias correction — HF ``AdamW(correct_bias=False)``
+    as the reference instantiates it (init.py:137)."""
+
+    def init_fn(params):
+        zeros = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)
+        return optax.ScaleByAdamState(
+            count=jnp.zeros([], jnp.int32), mu=zeros(), nu=zeros()
+        )
+
+    def update_fn(updates, state, params=None):
+        mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, updates)
+        nu = jax.tree_util.tree_map(
+            lambda v, g: b2 * v + (1 - b2) * g * g, state.nu, updates
+        )
+        new_updates = jax.tree_util.tree_map(
+            lambda m, v: m / (jnp.sqrt(v) + eps), mu, nu
+        )
+        return new_updates, optax.ScaleByAdamState(count=state.count + 1, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def no_decay_mask(params) -> dict:
+    """True where weight decay applies — everything except biases and
+    LayerNorm scales/biases (reference init.py:125-129 no_decay groups)."""
+
+    def decays(path, leaf):
+        names = [str(getattr(p, "key", p)) for p in path]
+        leaf_name = names[-1] if names else ""
+        if leaf_name == "bias":
+            return False
+        if any("layer_norm" in n for n in names):
+            return False
+        return True
+
+    return jax.tree_util.tree_map_with_path(decays, params)
+
+
+def trainable_mask(params, trainer_params) -> Optional[dict]:
+    """Fine-tune module selection (reference init.py:85-123): when
+    ``finetune`` is set, only the flagged modules receive updates."""
+    if not getattr(trainer_params, "finetune", False):
+        return None
+
+    wanted_roots = set()
+    if getattr(trainer_params, "finetune_transformer", False):
+        wanted_roots.add("transformer")
+    if getattr(trainer_params, "finetune_position", False):
+        wanted_roots.add("position_outputs")
+    if getattr(trainer_params, "finetune_position_reg", False):
+        wanted_roots.update(("reg_start", "reg_end"))
+    if getattr(trainer_params, "finetune_class", False):
+        wanted_roots.add("classifier")
+
+    if not wanted_roots:
+        raise AttributeError("Specify at least one module for fine-tuning.")
+
+    def trainable(path, leaf):
+        root = str(getattr(path[0], "key", path[0]))
+        return root in wanted_roots
+
+    return jax.tree_util.tree_map_with_path(trainable, params)
+
+
+def build_optimizer(
+    trainer_params,
+    params,
+    *,
+    num_training_steps: int,
+    max_grad_norm: Optional[float] = None,
+) -> tuple:
+    """Optimizer selection + schedule (reference init.py:134-145 +
+    trainer.py:116-126 + clip trainer.py:221-225 fused into one chain).
+
+    Returns ``(optax transform, schedule_fn)``.
+    """
+    warmup_coef = getattr(trainer_params, "warmup_coef", 0.0)
+    lr = trainer_params.lr
+
+    if warmup_coef and warmup_coef > 0:
+        num_warmup = int(num_training_steps * warmup_coef)
+        schedule = linear_warmup_schedule(lr, num_warmup, num_training_steps)
+    else:
+        schedule = lambda step: jnp.asarray(lr, jnp.float32)
+
+    decay_mask = no_decay_mask(params)
+
+    if getattr(trainer_params, "optimizer", "adam") == "adam":
+        # HF AdamW(correct_bias=False): no bias correction on the moments.
+        core = optax.chain(
+            _scale_by_adam_no_bias_correction(b1=0.9, b2=0.999, eps=1e-6),
+            optax.add_decayed_weights(trainer_params.weight_decay, mask=decay_mask),
+            optax.scale_by_learning_rate(schedule),
+        )
+    else:
+        core = adamod(
+            schedule,
+            weight_decay=trainer_params.weight_decay,
+        )
+
+    chain = [core]
+    if max_grad_norm is not None and max_grad_norm > 0:
+        chain.insert(0, optax.clip_by_global_norm(max_grad_norm))
+
+    tx = optax.chain(*chain)
+
+    tmask = trainable_mask(params, trainer_params)
+    if tmask is not None:
+        tx = optax.masked(tx, tmask)
+
+    return tx, schedule

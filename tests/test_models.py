@@ -1,0 +1,161 @@
+"""Model tests: shapes, output contract, HF numerical parity, remat, dtype."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ml_recipe_tpu.models import (
+    EncoderConfig,
+    QAModel,
+    QA_OUTPUT_KEYS,
+    TransformerEncoder,
+    resolve_model_config,
+)
+
+TINY = EncoderConfig(
+    vocab_size=100,
+    hidden_size=32,
+    num_layers=2,
+    num_heads=4,
+    intermediate_size=64,
+    max_position_embeddings=64,
+    num_labels=5,
+)
+
+
+def _batch(B=2, L=16, vocab=100, seed=0):
+    rng = np.random.default_rng(seed)
+    input_ids = rng.integers(0, vocab, (B, L)).astype(np.int32)
+    mask = np.ones((B, L), dtype=np.int32)
+    mask[0, L // 2 :] = 0  # one padded row
+    token_type_ids = np.zeros((B, L), dtype=np.int32)
+    return input_ids, mask, token_type_ids
+
+
+def test_encoder_shapes():
+    model = TransformerEncoder(TINY)
+    ids, mask, tt = _batch()
+    params = model.init(jax.random.key(0), ids, mask, tt)
+    seq, pooled = model.apply(params, ids, mask, tt)
+    assert seq.shape == (2, 16, 32)
+    assert pooled.shape == (2, 32)
+
+
+def test_qa_model_output_contract():
+    model = QAModel(TINY)
+    ids, mask, tt = _batch()
+    params = model.init(jax.random.key(0), ids, mask, tt)
+    out = model.apply(params, ids, mask, tt)
+    assert set(out.keys()) == set(QA_OUTPUT_KEYS)
+    assert out["start_class"].shape == (2, 16)
+    assert out["end_class"].shape == (2, 16)
+    assert out["cls"].shape == (2, 5)
+    assert out["start_reg"].shape == (2,)
+    assert out["end_reg"].shape == (2,)
+    # regressors in (0, 1) (sigmoid)
+    assert (out["start_reg"] > 0).all() and (out["start_reg"] < 1).all()
+    # padded positions masked out of span logits
+    assert (out["start_class"][0, 8:] < -1e8).all()
+    assert (out["start_class"][0, :8] > -1e8).all()
+
+
+def test_qa_model_dropout_rng():
+    model = QAModel(TINY)
+    ids, mask, tt = _batch()
+    params = model.init(jax.random.key(0), ids, mask, tt)
+    out1 = model.apply(params, ids, mask, tt, deterministic=False,
+                       rngs={"dropout": jax.random.key(1)})
+    out2 = model.apply(params, ids, mask, tt, deterministic=False,
+                       rngs={"dropout": jax.random.key(2)})
+    assert not np.allclose(out1["cls"], out2["cls"])
+    # deterministic mode ignores rngs
+    det1 = model.apply(params, ids, mask, tt)
+    det2 = model.apply(params, ids, mask, tt)
+    np.testing.assert_allclose(det1["cls"], det2["cls"])
+
+
+def test_remat_matches_plain():
+    ids, mask, tt = _batch()
+    plain = QAModel(TINY)
+    remat = QAModel(TINY, remat=True)
+    params = plain.init(jax.random.key(0), ids, mask, tt)
+    out_p = plain.apply(params, ids, mask, tt)
+    out_r = remat.apply(params, ids, mask, tt)
+    np.testing.assert_allclose(out_p["cls"], out_r["cls"], atol=1e-5)
+
+
+def test_bf16_compute():
+    model = QAModel(TINY, dtype=jnp.bfloat16)
+    ids, mask, tt = _batch()
+    params = model.init(jax.random.key(0), ids, mask, tt)
+    # params stay f32
+    flat = jax.tree_util.tree_leaves(params)
+    assert all(p.dtype == jnp.float32 for p in flat)
+    out = model.apply(params, ids, mask, tt)
+    # outputs promoted to f32 for the loss
+    assert out["cls"].dtype == jnp.float32
+
+
+def test_resolve_model_config():
+    class P:
+        model = "roberta-base"
+        hidden_dropout_prob = 0.2
+        attention_probs_dropout_prob = 0.1
+        layer_norm_eps = 1e-5
+
+    cfg = resolve_model_config(P())
+    assert cfg.model_type == "roberta"
+    assert cfg.position_offset == 2
+    assert cfg.hidden_dropout_prob == 0.2
+    assert cfg.num_labels == 5
+
+
+def test_hf_numerical_parity():
+    """Convert a tiny randomly-initialized HF BertModel and match outputs."""
+    torch = pytest.importorskip("torch")
+    from transformers import BertConfig, BertModel
+
+    from ml_recipe_tpu.models.hf_convert import hf_to_encoder_params
+
+    hf_cfg = BertConfig(
+        vocab_size=100,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        intermediate_size=64,
+        max_position_embeddings=64,
+        type_vocab_size=2,
+        hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+        layer_norm_eps=1e-12,
+    )
+    hf_model = BertModel(hf_cfg).eval()
+
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    encoder_params = hf_to_encoder_params(sd, num_layers=2)
+
+    cfg = EncoderConfig(
+        vocab_size=100, hidden_size=32, num_layers=2, num_heads=4,
+        intermediate_size=64, max_position_embeddings=64,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+    )
+    model = TransformerEncoder(cfg)
+
+    ids, mask, tt = _batch(B=2, L=12)
+    with torch.no_grad():
+        hf_out = hf_model(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask, dtype=torch.long),
+            token_type_ids=torch.tensor(tt, dtype=torch.long),
+        )
+
+    seq, pooled = model.apply({"params": encoder_params}, ids, mask, tt)
+
+    np.testing.assert_allclose(
+        np.asarray(seq), hf_out.last_hidden_state.numpy(), atol=5e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(pooled), hf_out.pooler_output.numpy(), atol=5e-3
+    )
