@@ -47,6 +47,7 @@ class ShardedBatchSampler:
         shuffle: bool = True,
         weights: Optional[Sequence[float]] = None,
         drop_last: bool = True,
+        pad_last: bool = False,
         seed: int = 0,
     ):
         assert global_batch_size % process_count == 0, (
@@ -60,6 +61,10 @@ class ShardedBatchSampler:
         self.shuffle = shuffle
         self.weights = None if weights is None else np.asarray(weights, dtype=np.float64)
         self.drop_last = drop_last
+        # pad_last keeps the final partial batch at the full static shape by
+        # repeating the last index (fixed-shape discipline: one compiled
+        # program serves eval too). Consumers trim with `valid_count(b)`.
+        self.pad_last = pad_last and not drop_last
         self.seed = seed
 
     def __len__(self) -> int:
@@ -76,13 +81,26 @@ class ShardedBatchSampler:
             return rng.permutation(self.dataset_len)
         return np.arange(self.dataset_len)
 
+    def valid_count(self, batch_index: int) -> int:
+        """Number of real (non-padding) rows in the given *global* batch."""
+        remaining = self.dataset_len - batch_index * self.global_batch_size
+        return int(min(self.global_batch_size, max(remaining, 0)))
+
     def __call__(self, epoch: int) -> Iterator[np.ndarray]:
         indices = self.epoch_indices(epoch)
         n_batches = len(self)
         for b in range(n_batches):
             global_batch = indices[b * self.global_batch_size : (b + 1) * self.global_batch_size]
-            if len(global_batch) < self.global_batch_size and self.drop_last:
-                return
+            if len(global_batch) < self.global_batch_size:
+                if self.drop_last:
+                    return
+                if self.pad_last:
+                    pad = np.full(
+                        self.global_batch_size - len(global_batch),
+                        global_batch[-1] if len(global_batch) else 0,
+                        dtype=indices.dtype,
+                    )
+                    global_batch = np.concatenate([global_batch, pad])
             lo = self.process_index * self.local_batch_size
             hi = lo + self.local_batch_size
             yield global_batch[lo:hi]

@@ -142,6 +142,13 @@ class WeightedLoss:
     def keys(self):
         return self._losses.keys()
 
+    def value_structure(self) -> dict:
+        """Zero-valued dict with the shape of ``__call__``'s values output —
+        used as the scan carry init for in-step gradient accumulation."""
+        out = {key: 0.0 for key in self._losses}
+        out["loss"] = 0.0
+        return out
+
     def __call__(self, preds: dict, targets: dict) -> Tuple[jnp.ndarray, dict]:
         assert set(preds.keys()) >= set(self._losses.keys())
         assert set(targets.keys()) >= set(self._losses.keys())
@@ -171,9 +178,10 @@ def build_loss(params, train_weights: Optional[dict] = None) -> WeightedLoss:
             cross_entropy_with_ignore, ignore_index=-100, class_weights=label_weights
         )
     elif params.loss == "focal":
+        # reference FocalLossWithLogits defaults to ignore_index=-1 (loss.py:59)
         class_loss = functools.partial(
             focal_loss, alpha=params.focal_alpha, gamma=params.focal_gamma,
-            ignore_index=-100,
+            ignore_index=-1,
         )
     elif params.loss == "smooth":
         class_loss = functools.partial(

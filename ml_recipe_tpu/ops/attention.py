@@ -73,9 +73,16 @@ def dot_product_attention(
         impl = "pallas" if use_pallas else "xla"
 
     if impl == "pallas":
-        from .flash_attention import flash_attention
+        try:
+            from .flash_attention import flash_attention
+        except ImportError:  # kernel unavailable on this build — fall back
+            import logging
 
-        return flash_attention(q, k, v, mask, dtype=dtype)
+            logging.getLogger(__name__).warning(
+                "Pallas flash-attention kernel unavailable; falling back to XLA."
+            )
+        else:
+            return flash_attention(q, k, v, mask, dtype=dtype)
 
     return _xla_attention(
         q, k, v, mask, dropout_rate=dropout_rate, dropout_rng=dropout_rng, dtype=dtype

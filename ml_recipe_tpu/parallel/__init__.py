@@ -16,6 +16,7 @@ from .sharding import (
     param_pspecs,
     shard_params,
     make_global_array,
+    gather_to_host,
     TP_RULES,
 )
 from .collectives import pmean, psum_scalar, cross_replica_mean
@@ -38,6 +39,7 @@ __all__ = [
     "param_pspecs",
     "shard_params",
     "make_global_array",
+    "gather_to_host",
     "TP_RULES",
     "pmean",
     "psum_scalar",

@@ -100,17 +100,40 @@ def batch_sharding(mesh: Mesh, batch_tree, *, shard_seq: bool = False):
     )
 
 
-def make_global_array(host_batch, mesh: Mesh, *, shard_seq: bool = False):
+def make_global_array(
+    host_batch, mesh: Mesh, *, shard_seq: bool = False, batch_axis: int = 0
+):
     """Assemble per-host numpy shards into global jax.Arrays.
 
     Single-process: a plain sharded device_put. Multi-host: each process
     contributes its local rows (`jax.make_array_from_process_local_data`).
+    ``batch_axis`` selects which dim is sharded over ``data`` (axis 1 for
+    micro-batch-major [G, B, ...] layouts used by in-step grad accumulation).
     """
     def to_global(x):
         x = np.asarray(x)
-        sharding = NamedSharding(mesh, batch_pspec(mesh, shard_seq=shard_seq, ndim=x.ndim))
+        if batch_axis == 0:
+            spec = batch_pspec(mesh, shard_seq=shard_seq, ndim=x.ndim)
+        else:
+            axes = [None] * x.ndim
+            axes[batch_axis] = DATA_AXIS
+            spec = P(*axes)
+        sharding = NamedSharding(mesh, spec)
         if jax.process_count() == 1:
             return jax.device_put(x, sharding)
         return jax.make_array_from_process_local_data(sharding, x)
 
     return jax.tree_util.tree_map(to_global, host_batch)
+
+
+def gather_to_host(tree):
+    """Device tree (possibly multi-host-sharded) -> full host numpy tree."""
+
+    def gather(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree_util.tree_map(gather, tree)

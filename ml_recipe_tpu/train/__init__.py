@@ -1,7 +1,8 @@
 from .optim import build_optimizer, adamod, linear_warmup_schedule
 from .trainer import Trainer
-from .callbacks import TestCallback, AccuracyCallback, MAPCallback, SaveBestCallback
-from .checkpoint import save_checkpoint, load_checkpoint
+from .callback import TestCallback, AccuracyCallback, MAPCallback, SaveBestCallback
+from .checkpoint import save_state_dict, load_state_dict
+from .writer import SummaryWriter, init_writer
 
 __all__ = [
     "build_optimizer",
@@ -12,6 +13,8 @@ __all__ = [
     "AccuracyCallback",
     "MAPCallback",
     "SaveBestCallback",
-    "save_checkpoint",
-    "load_checkpoint",
+    "save_state_dict",
+    "load_state_dict",
+    "SummaryWriter",
+    "init_writer",
 ]

@@ -51,6 +51,7 @@ def adamod(
     beta3: float = 0.999,
     eps: float = 1e-8,
     weight_decay: float = 0.0,
+    decay_mask=None,
     mask=None,
 ) -> optax.GradientTransformation:
     """AdaMod: Adam with momental bounds on per-param learning rates.
@@ -58,6 +59,8 @@ def adamod(
     Matches the reference implementation step-for-step (trainer/optim.py:73-98):
     bias-corrected Adam step size per element, EMA-smoothed (beta3) upper
     bound, decoupled weight decay applied as ``p -= wd * lr * p``.
+    ``decay_mask`` (True = decay) reproduces the reference's no-decay param
+    groups for bias/LayerNorm (init.py:124-128).
     """
 
     def init_fn(params):
@@ -72,7 +75,9 @@ def adamod(
     def update_fn(updates, state, params):
         assert params is not None, "adamod requires params for weight decay"
         count = state.count + 1
-        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+        # Schedule indexed by the PRE-increment count: the first step trains
+        # with schedule(0), matching the HF scheduler and the adam branch.
+        lr = learning_rate(state.count) if callable(learning_rate) else learning_rate
 
         exp_avg = jax.tree_util.tree_map(
             lambda m, g: b1 * m + (1 - b1) * g, state.exp_avg, updates
@@ -85,13 +90,13 @@ def adamod(
         bias2 = 1 - b2 ** count.astype(jnp.float32)
         step_scale = lr * jnp.sqrt(bias2) / bias1
 
-        def bounded_step(m, v, ema_lr, p):
+        def bounded_step(m, v, ema_lr, p, decays):
             denom = jnp.sqrt(v) + eps
             step_size = step_scale / denom
             new_ema_lr = beta3 * ema_lr + (1 - beta3) * step_size
             step_size = jnp.minimum(step_size, new_ema_lr)
             delta = -step_size * m
-            if weight_decay != 0:
+            if weight_decay != 0 and decays:
                 delta = delta - weight_decay * lr * p
             return delta, new_ema_lr
 
@@ -99,10 +104,15 @@ def adamod(
         flat_v = treedef.flatten_up_to(exp_avg_sq)
         flat_e = treedef.flatten_up_to(state.exp_avg_lr)
         flat_p = treedef.flatten_up_to(params)
+        flat_d = (
+            treedef.flatten_up_to(decay_mask)
+            if decay_mask is not None
+            else [True] * len(flat_m)
+        )
 
         deltas, new_emas = [], []
-        for m, v, e, p in zip(flat_m, flat_v, flat_e, flat_p):
-            d, ne = bounded_step(m, v, e, p)
+        for m, v, e, p, d_ in zip(flat_m, flat_v, flat_e, flat_p, flat_d):
+            d, ne = bounded_step(m, v, e, p, d_)
             deltas.append(d)
             new_emas.append(ne)
 
@@ -220,6 +230,7 @@ def build_optimizer(
         core = adamod(
             schedule,
             weight_decay=trainer_params.weight_decay,
+            decay_mask=decay_mask,
         )
 
     chain = [core]

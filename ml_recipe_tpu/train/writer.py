@@ -113,10 +113,15 @@ class SummaryWriter:
         self._fh = open(self._path, "ab")
         self._fh.write(_record(_encode_event(time.time(), 0, file_version="brain.Event:2")))
         self._fh.flush()
+        self._pending = 0
 
     def add_scalar(self, tag: str, value, global_step: int = 0) -> None:
         payload = _encode_event(time.time(), int(global_step), {tag: float(value)})
         self._fh.write(_record(payload))
+        self._pending += 1
+        if self._pending >= 512:  # bound event loss under SIGKILL/preemption
+            self._fh.flush()
+            self._pending = 0
 
     def flush(self) -> None:
         self._fh.flush()

@@ -36,7 +36,7 @@ def test_cross_entropy_matches_torch():
     ref = torch.nn.CrossEntropyLoss(ignore_index=-1)(
         torch.tensor(logits), torch.tensor(targets, dtype=torch.long)
     )
-    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-5)
+    np.testing.assert_allclose(float(ours), float(ref), rtol=5e-5)
 
 
 def test_cross_entropy_class_weights_matches_torch():
@@ -50,7 +50,7 @@ def test_cross_entropy_class_weights_matches_torch():
     ref = torch.nn.CrossEntropyLoss(weight=torch.tensor(w))(
         torch.tensor(logits), torch.tensor(targets, dtype=torch.long)
     )
-    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-5)
+    np.testing.assert_allclose(float(ours), float(ref), rtol=5e-5)
 
 
 def test_label_smoothing_matches_torch_kldiv():
@@ -69,7 +69,7 @@ def test_label_smoothing_matches_torch_kldiv():
     dist = torch.full((8, n_classes), fill)
     dist.scatter_(-1, torch.tensor(targets, dtype=torch.long).unsqueeze(-1), 1 - smoothing)
     ref = torch.nn.KLDivLoss(reduction="batchmean")(log_probs, dist)
-    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-5)
+    np.testing.assert_allclose(float(ours), float(ref), rtol=5e-5)
 
 
 def test_label_smoothing_zero_falls_back_to_nll():
@@ -82,7 +82,7 @@ def test_label_smoothing_zero_falls_back_to_nll():
         torch.log_softmax(torch.tensor(logits), dim=-1),
         torch.tensor(targets, dtype=torch.long),
     )
-    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-5)
+    np.testing.assert_allclose(float(ours), float(ref), rtol=5e-5)
 
 
 def test_binary_focal_matches_torch():
@@ -99,7 +99,7 @@ def test_binary_focal_matches_torch():
     )
     probs = torch.exp(-bce)
     ref = torch.mean(alpha * (1 - probs) ** gamma * bce)
-    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-5)
+    np.testing.assert_allclose(float(ours), float(ref), rtol=5e-5)
 
 
 def test_focal_matches_torch():
@@ -114,7 +114,7 @@ def test_focal_matches_torch():
     ref = torch.nn.NLLLoss(ignore_index=-1)(
         alpha * (1 - probs) ** gamma * log_probs, torch.tensor(targets, dtype=torch.long)
     )
-    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-5)
+    np.testing.assert_allclose(float(ours), float(ref), rtol=5e-5)
 
 
 def test_mse():

@@ -1,0 +1,215 @@
+"""Trainer runtime tests on the virtual 8-device CPU mesh.
+
+Covers the SURVEY.md §7 minimum end-to-end slice: DummyDataset + fixed-shape
+collate + tiny QA model + WeightedLoss + jitted SPMD train step with gradient
+accumulation, eval with callbacks, and checkpoint save/load round-trip.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ml_recipe_tpu.data.collate import make_collate_fun
+from ml_recipe_tpu.data.datasets import DummyDataset
+from ml_recipe_tpu.losses import build_loss
+from ml_recipe_tpu.models import EncoderConfig, QAModel
+from ml_recipe_tpu.parallel import build_mesh
+from ml_recipe_tpu.train import (
+    AccuracyCallback,
+    MAPCallback,
+    SaveBestCallback,
+    Trainer,
+)
+
+from helpers import make_tokenizer
+
+TINY = EncoderConfig(
+    vocab_size=50,
+    hidden_size=16,
+    num_layers=2,
+    num_heads=2,
+    intermediate_size=32,
+    max_position_embeddings=64,
+    num_labels=5,
+)
+
+MAX_SEQ_LEN = 48
+MAX_Q_LEN = 12
+
+
+class TP:
+    """Tiny trainer-params namespace (subset of get_trainer_parser flags)."""
+
+    loss = "ce"
+    smooth_alpha = 0.01
+    focal_alpha = 1
+    focal_gamma = 2
+    w_start = 1
+    w_end = 1
+    w_start_reg = 0.5
+    w_end_reg = 0.5
+    w_cls = 1
+    lr = 1e-3
+    weight_decay = 0.01
+    warmup_coef = 0.1
+    optimizer = "adam"
+    finetune = False
+    best_metric = "map"
+    best_order = ">"
+
+
+def _make_trainer(tmp_path, *, batch_split=1, n_epochs=1, debug=False,
+                  train_len=32, test_len=10, dropout=0.1):
+    tokenizer = make_tokenizer(tmp_path)
+    rng = np.random.default_rng(0)
+    train_ds = DummyDataset(
+        tokenizer=tokenizer, max_seq_len=MAX_SEQ_LEN, max_question_len=MAX_Q_LEN,
+        dataset_len=train_len, rng=rng,
+    )
+    test_ds = DummyDataset(
+        tokenizer=tokenizer, max_seq_len=MAX_SEQ_LEN, max_question_len=MAX_Q_LEN,
+        dataset_len=test_len, rng=rng,
+    )
+
+    cfg = EncoderConfig(
+        vocab_size=len(tokenizer), hidden_size=16, num_layers=2, num_heads=2,
+        intermediate_size=32, max_position_embeddings=MAX_SEQ_LEN + 2, num_labels=5,
+        hidden_dropout_prob=dropout, attention_probs_dropout_prob=dropout,
+    )
+    model = QAModel(cfg)
+    sample = train_ds[0]
+    params = model.init(
+        jax.random.key(0),
+        np.asarray(sample.input_ids, dtype=np.int32)[None, :],
+    )["params"]
+
+    trainer = Trainer(
+        model=model,
+        params=params,
+        loss=build_loss(TP()),
+        collate_fun=make_collate_fun(tokenizer, max_seq_len=MAX_SEQ_LEN),
+        trainer_params=TP(),
+        train_dataset=train_ds,
+        test_dataset=test_ds,
+        mesh=build_mesh("data:8"),
+        n_epochs=n_epochs,
+        train_batch_size=16,
+        test_batch_size=8,
+        batch_split=batch_split,
+        n_jobs=2,
+        warmup_coef=TP.warmup_coef,
+        max_grad_norm=1.0,
+        debug=debug,
+        seed=0,
+    )
+    return trainer, tmp_path
+
+
+def _param_snapshot(params):
+    return jax.tree_util.tree_map(lambda x: np.asarray(x).copy(), params)
+
+
+def test_train_updates_params_and_steps(tmp_path):
+    trainer, _ = _make_trainer(tmp_path)
+    before = _param_snapshot(trainer.params)
+    trainer.train()
+    after = _param_snapshot(trainer.params)
+
+    assert trainer.global_step == len(trainer.train_dataloader)
+    changed = jax.tree_util.tree_map(
+        lambda a, b: not np.allclose(a, b), before, after
+    )
+    assert any(jax.tree_util.tree_leaves(changed)), "params did not update"
+
+
+def test_grad_accumulation_matches_single_step(tmp_path):
+    """batch_split must not change the optimizer trajectory (same global
+    batch, same data order): reference semantics trainer.py:197-204."""
+    # both trainers init from jax.random.key(0) -> identical starting params;
+    # dropout off: micro-batches draw different dropout keys by design, the
+    # equivalence is only exact deterministically (labels are all valid here,
+    # so per-micro-batch CE normalization matches the global mean too)
+    t1, _ = _make_trainer(tmp_path, batch_split=1, dropout=0.0)
+    t2, _ = _make_trainer(tmp_path, batch_split=2, dropout=0.0)
+
+    t1.train()
+    t2.train()
+
+    a = jax.tree_util.tree_leaves(_param_snapshot(t1.params))
+    b = jax.tree_util.tree_leaves(_param_snapshot(t2.params))
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(x, y, rtol=2e-4, atol=2e-5)
+
+
+def test_test_loop_with_callbacks(tmp_path):
+    trainer, _ = _make_trainer(tmp_path)
+    metrics = trainer.test(
+        0,
+        callbacks=[
+            MAPCallback(["yes", "no", "short", "long", "unknown"]),
+            AccuracyCallback(),
+        ],
+    )
+    assert "loss" in metrics
+    assert "map" in metrics
+    assert "c_acc" in metrics
+    assert 0 <= metrics["c_acc"] <= 1
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    trainer, _ = _make_trainer(tmp_path)
+    trainer.train()
+    step = trainer.global_step
+    ckpt = tmp_path / "last.ch"
+    trainer.save_state_dict(ckpt)
+    assert ckpt.exists()
+
+    (tmp_path / "t2").mkdir()
+    trainer2, _ = _make_trainer(tmp_path / "t2")
+    trainer2.load_state_dict(ckpt)
+    assert trainer2.global_step == step
+    for x, y in zip(
+        jax.tree_util.tree_leaves(_param_snapshot(trainer.params)),
+        jax.tree_util.tree_leaves(_param_snapshot(trainer2.params)),
+    ):
+        np.testing.assert_allclose(x, y, rtol=1e-6)
+
+    # drop_optimizer restores weights only (reference trainer.py:395-403)
+    (tmp_path / "t3").mkdir()
+    trainer3, _ = _make_trainer(tmp_path / "t3")
+    trainer3.drop_optimizer = True
+    trainer3.load_state_dict(ckpt)
+    assert trainer3.global_step == step
+
+
+def test_debug_mode_breaks_after_one_step(tmp_path):
+    trainer, _ = _make_trainer(tmp_path, debug=True)
+    assert trainer.n_epochs == 2  # debug truncates epochs (trainer.py:147-148)
+    trainer.train()
+    assert trainer.global_step == 2  # one optimizer step per epoch
+
+    # debug skips checkpoint writes (trainer.py:359-361)
+    ckpt = tmp_path / "debug.ch"
+    trainer.save_state_dict(ckpt)
+    assert not ckpt.exists()
+
+
+def test_save_best_callback(tmp_path):
+    trainer, _ = _make_trainer(tmp_path)
+
+    class P:
+        best_metric = "map"
+        best_order = ">"
+        dump_dir = tmp_path
+        experiment_name = "exp"
+
+    cb = SaveBestCallback(P())
+    trainer.test(0, callbacks=[cb, MAPCallback(["a", "b", "c", "d", "e"])])
+    # MAPCallback runs after SaveBest in this order; run again so map exists
+    metrics = trainer.test(
+        0, callbacks=[MAPCallback(["a", "b", "c", "d", "e"]), cb]
+    )
+    if not np.isnan(metrics.get("map", np.nan)):
+        assert (tmp_path / "exp" / "best.ch").exists()
