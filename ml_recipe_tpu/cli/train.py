@@ -1,0 +1,179 @@
+"""Distributed training entry point.
+
+Parity target: reference ``modules/train.py`` — config parsing + round-trip
+serialization (train.py:151-165), topology setup, worker bootstrap with NCCL
+rendezvous (train.py:18-59), Trainer construction with after-epoch hooks
+``save_last``/``save_each``/``test_fun`` (train.py:104-116), KeyboardInterrupt
+-> ``interrupt.ch`` (train.py:117-119).
+
+TPU redesign: ONE process per host (no ``mp.spawn`` fan-out — SPMD covers all
+local devices through the mesh), ``jax.distributed.initialize`` replaces the
+TCP process group, and the mesh spec replaces world-size arithmetic
+(train.py:133-136). Run under the same env contract the platform launcher
+exports (MASTER_IP/MASTER_PORT/LOCAL_RANK/WORLD_SIZE → flags, worker.sh:6).
+
+Usage::
+
+    python -m ml_recipe_tpu.cli.train -c config/test_bert.cfg [--flag value ...]
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+from datetime import datetime
+
+from ..compose import init_collate_fun, init_datasets, init_loss, init_model
+from ..config.parser import (
+    get_model_parser,
+    get_params,
+    get_trainer_parser,
+    write_config_file,
+)
+from ..data import RawPreprocessor
+from ..parallel import barrier, build_mesh, initialize_from_params, is_primary
+from ..train import AccuracyCallback, MAPCallback, SaveBestCallback, Trainer
+from ..utils.logging import get_logger, show_params
+from ..utils.seed import set_seed
+
+logger = logging.getLogger(__name__)
+
+
+def run_worker(params, model_params) -> None:
+    """One SPMD host process (reference run_worker, train.py:18-122)."""
+    import jax
+
+    log_file = params.log_file if is_primary() else None
+    log_level = logging.INFO if is_primary() else logging.WARN
+    local_logger = get_logger(
+        level=log_level, filename=str(log_file) if log_file else None,
+        filemode="a", logger_name="train", debug=params.debug,
+    )
+
+    mesh = build_mesh(params.mesh)
+    local_logger.warning(
+        f"Process {jax.process_index()}/{jax.process_count()}. "
+        f"Mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}. "
+        f"Global batch {params.train_batch_size} spans the whole data axis — "
+        f"scale the learning rate for the GLOBAL batch, not per-device."
+    )
+
+    rng_pool = set_seed(params.seed)
+    data_rng = rng_pool.host_rng("chunk_sampling") if rng_pool else None
+
+    model, model_state, tokenizer = init_model(
+        model_params, bpe_dropout=params.bpe_dropout,
+        rng_seed=params.seed if params.seed is not None else 0,
+    )
+
+    # Rank 0 prepares the (shared-dir) dataset; everyone else waits, then
+    # loads the cached artifacts (train.py:49-59).
+    if is_primary():
+        train_dataset, test_dataset, train_weights = init_datasets(
+            params, tokenizer=tokenizer, clear=params.clear_processed, rng=data_rng
+        )
+    barrier("dataset_prep")
+    if not is_primary():
+        train_dataset, test_dataset, train_weights = init_datasets(
+            params, tokenizer=tokenizer, clear=False, rng=data_rng
+        )
+
+    loss = init_loss(params, train_weights)
+
+    trainer = Trainer(
+        model=model,
+        params=model_state,
+        loss=loss,
+        collate_fun=init_collate_fun(tokenizer, max_seq_len=params.max_seq_len),
+        trainer_params=params,
+        train_dataset=train_dataset,
+        test_dataset=test_dataset,
+        writer_dir=params.dump_dir / f"board/{params.experiment_name}",
+        mesh=mesh,
+        n_epochs=params.n_epochs,
+        train_batch_size=params.train_batch_size,
+        test_batch_size=params.test_batch_size,
+        batch_split=params.batch_split,
+        n_jobs=params.n_jobs,
+        warmup_coef=params.warmup_coef,
+        max_grad_norm=params.max_grad_norm,
+        train_weights=train_weights,
+        drop_optimizer=params.drop_optimizer,
+        debug=params.debug,
+        seed=params.seed if params.seed is not None else 0,
+    )
+
+    if params.last is not None:
+        trainer.load_state_dict(params.last)
+
+    def save_last(*args, **kwargs):
+        trainer.save_state_dict(params.dump_dir / params.experiment_name / "last.ch")
+
+    def save_each(epoch_i):
+        trainer.save_state_dict(
+            params.dump_dir / params.experiment_name / f"epoch_{epoch_i}.ch"
+        )
+
+    test_fun = functools.partial(
+        trainer.test,
+        callbacks=[
+            MAPCallback(list(RawPreprocessor.labels2id.keys())),
+            AccuracyCallback(),
+            SaveBestCallback(params),
+        ],
+    )
+
+    try:
+        trainer.train(after_epoch_funcs=[save_last, save_each, test_fun])
+    except KeyboardInterrupt:
+        local_logger.error("Training process was interrupted.")
+        trainer.save_state_dict(params.dump_dir / params.experiment_name / "interrupt.ch")
+    except Exception as e:
+        local_logger.error(e)
+        raise e
+
+
+def main(params, model_params) -> None:
+    show_params(model_params, "model")
+    show_params(params, "trainer")
+
+    # Join the multi-host world BEFORE any jax device use (train.py:27-28's
+    # init_process_group, re-expressed as jax.distributed.initialize).
+    initialize_from_params(params)
+
+    run_worker(params, model_params)
+
+
+def cli() -> None:
+    (parser, model_parser), (params, model_params) = get_params(
+        (get_trainer_parser, get_model_parser)
+    )
+
+    os.makedirs(params.dump_dir / params.experiment_name, exist_ok=True)
+
+    params.log_file = (
+        params.dump_dir / params.experiment_name
+        / f'{datetime.now().strftime("%d-%m-%Y_%H-%M-%S")}.log'
+        if params.local_rank in [-1, 0]
+        else None
+    )
+
+    params.n_jobs = max(1, min(params.n_jobs, (os.cpu_count() or 2) // 2))
+
+    get_logger(
+        filename=str(params.log_file) if params.log_file else None,
+        filemode="w", logger_name="train", debug=params.debug,
+    )
+
+    if params.local_rank in [0, -1]:
+        write_config_file(parser, params, params.dump_dir / params.experiment_name / "trainer.cfg")
+        write_config_file(
+            model_parser, model_params, params.dump_dir / params.experiment_name / "model.cfg"
+        )
+
+    main(params, model_params)
+
+
+if __name__ == "__main__":
+    cli()

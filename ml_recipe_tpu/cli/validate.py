@@ -1,0 +1,67 @@
+"""Validation / prediction entry point.
+
+Parity target: reference ``modules/validate.py`` — load checkpoint, build a
+``ChunkDataset`` over the held-out split (validate.py:15-26), run the
+``Predictor`` over all chunks (validate.py:29-54).
+
+The reference swapped its fast Rust tokenizer for the slow HF one here
+because the Rust object could not cross ``mp.Pool`` pickling
+(validate.py:37-39 todo). Our first-party tokenizer streams through the
+thread-pool ``ListDataloader`` directly — no swap needed.
+
+Usage::
+
+    python -m ml_recipe_tpu.cli.validate -c config/validate.cfg
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..compose import init_collate_fun, init_model, init_validation_dataset
+from ..config.parser import get_model_parser, get_params, get_predictor_parser
+from ..infer import Predictor
+from ..parallel import build_mesh
+from ..utils.logging import get_logger, show_params
+
+
+def main(params, model_params):
+    show_params(model_params, "model")
+    show_params(params, "predictor")
+
+    model, model_state, tokenizer = init_model(
+        model_params, checkpoint=params.checkpoint
+    )
+
+    val_dataset = init_validation_dataset(params, tokenizer=tokenizer, clear=False)
+
+    collate_fun = init_collate_fun(
+        tokenizer, max_seq_len=params.max_seq_len, return_items=True
+    )
+    predictor = Predictor(
+        model,
+        model_state,
+        mesh=build_mesh(getattr(params, "mesh", None)),
+        collate_fun=collate_fun,
+        batch_size=params.batch_size,
+        n_jobs=params.n_jobs,
+        buffer_size=params.buffer_size,
+        limit=params.limit,
+    )
+
+    predictor(val_dataset)
+
+    return predictor
+
+
+def cli() -> None:
+    _, (params, model_params) = get_params((get_predictor_parser, get_model_parser))
+    get_logger(logger_name="validate")
+
+    params.n_jobs = max(1, min(params.n_jobs, (os.cpu_count() or 2) // 2))
+
+    main(params, model_params)
+
+
+if __name__ == "__main__":
+    cli()

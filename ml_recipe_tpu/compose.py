@@ -1,0 +1,221 @@
+"""Composition root.
+
+Parity target: reference ``modules/init.py`` — loss zoo selection
+(``init_loss`` init.py:18-40), model+tokenizer construction with fast-native
+vs HF fallback (``init_model`` init.py:51-82), dataset construction with
+label/sampler weight computation (``init_datasets`` init.py:148-201), collate
+binding (``init_collate_fun`` init.py:204-205).
+
+Optimizer construction (reference ``init_optimizer`` init.py:134-145 +
+``_get_optimized_parameters`` init.py:85-131) lives in
+:func:`ml_recipe_tpu.train.optim.build_optimizer`, invoked inside the Trainer
+— on TPU the optimizer is part of the jitted step, so it must be built where
+the step is compiled (it needs ``num_training_steps`` for the schedule).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from .data import (
+    ChunkDataset,
+    DummyDataset,
+    RawPreprocessor,
+    SplitDataset,
+    collate_fun,
+)
+from .losses import WeightedLoss, build_loss
+from .models import QAModel, resolve_model_config
+from .models.hf_convert import load_pretrained_into
+from .tokenizer import Tokenizer
+
+logger = logging.getLogger(__name__)
+
+
+def init_loss(params, train_weights=None) -> WeightedLoss:
+    """Loss zoo selection + per-head weights (init.py:18-40)."""
+    loss = build_loss(params, train_weights)
+    logger.info(f"Used loss function for classification: {params.loss}.")
+    return loss
+
+
+def init_tokenizer(model_params, *, bpe_dropout: Optional[float] = None):
+    """First-party fast tokenizer when a vocab file is given; HF fallback
+    otherwise (init.py:57-77 semantics, minus the Rust dependency)."""
+    model_name = model_params.model.split("-")[0]
+
+    if model_params.vocab_file is not None and not os.path.exists(model_params.vocab_file):
+        raise FileNotFoundError(
+            f"vocab_file {model_params.vocab_file!r} does not exist. Generate one "
+            f"(ml_recipe_tpu.tokenizer.write_synthetic_bert_vocab) or fix the path."
+        )
+
+    if model_params.vocab_file is not None:
+        return Tokenizer(
+            model_name=model_name,
+            vocab_file=model_params.vocab_file,
+            merges_file=model_params.merges_file,
+            lowercase=model_params.lowercase,
+            handle_chinese_chars=model_params.handle_chinese_chars,
+            dropout=bpe_dropout,
+        )
+
+    logger.warning("Specify vocab file to use faster tokenizer implementation.")
+    try:
+        if model_name == "bert":
+            from transformers import BertTokenizer
+
+            tokenizer = BertTokenizer.from_pretrained(model_params.model)
+        elif model_name == "roberta":
+            from transformers import RobertaTokenizer
+
+            tokenizer = RobertaTokenizer.from_pretrained(model_params.model)
+        else:
+            raise NotImplementedError(model_name)
+    except Exception as e:  # offline environments have no HF hub access
+        raise RuntimeError(
+            f"No vocab_file given and HF tokenizer for {model_params.model!r} "
+            f"unavailable ({e}). Pass --vocab_file."
+        ) from e
+
+    tokenizer.model_name = model_name
+    return tokenizer
+
+
+def init_model(
+    model_params,
+    *,
+    checkpoint: Optional[str] = None,
+    bpe_dropout: Optional[float] = None,
+    rng_seed: int = 0,
+) -> Tuple[QAModel, dict, object]:
+    """Build (model, params, tokenizer) — reference init.py:51-82.
+
+    Weight priority: explicit ``checkpoint`` (our msgpack format, model part
+    only — the reference's strict=False torch.load, init.py:43-48) >
+    ``model_params.hf_checkpoint`` (converted HF torch weights) > random init.
+    """
+    import jax.numpy as jnp
+
+    tokenizer = init_tokenizer(model_params, bpe_dropout=bpe_dropout)
+
+    cfg = resolve_model_config(model_params, num_labels=len(RawPreprocessor.labels2id))
+    dtype = jnp.bfloat16 if getattr(model_params, "compute_dtype", "bfloat16") == "bfloat16" else jnp.float32
+    attention_impl = getattr(model_params, "flash_attention", "auto") or "auto"
+    model = QAModel(
+        cfg,
+        dtype=dtype,
+        attention_impl=attention_impl,
+        remat=getattr(model_params, "remat", False),
+    )
+
+    example = np.zeros((1, 8), dtype=np.int32)
+    params = model.init(jax.random.key(rng_seed), example)["params"]
+
+    hf_checkpoint = getattr(model_params, "hf_checkpoint", None)
+    if hf_checkpoint:
+        params = load_pretrained_into(params, hf_checkpoint, cfg.num_layers)
+        logger.info(f"Encoder weights converted from HF checkpoint {hf_checkpoint}.")
+
+    if checkpoint is not None:
+        from .train.checkpoint import load_state_dict
+
+        params, _, loaded_step = load_state_dict(checkpoint, params=params)
+        if loaded_step is not None:
+            logger.info(f"Model checkpoint was restored from {checkpoint}.")
+
+    return model, params, tokenizer
+
+
+def init_datasets(params, *, tokenizer=None, clear: bool = False, rng=None):
+    """Datasets + label/sampler weights (init.py:148-201).
+
+    TPU delta: the test dataset is built on EVERY process (eval runs SPMD;
+    the reference gated it to rank 0, init.py:195-200).
+    """
+    weights = {"label_weights": None, "sampler_weights": None}
+
+    if getattr(params, "dummy_dataset", False):
+        logger.warning("Dummy dataset is used to train model.")
+        common = dict(
+            data_dir=None,
+            tokenizer=tokenizer,
+            indexes=None,
+            max_seq_len=params.max_seq_len,
+            max_question_len=params.max_question_len,
+            rng=rng,
+        )
+        return DummyDataset(**common), DummyDataset(dataset_len=1024, **common), weights
+
+    preprocessor = RawPreprocessor(
+        raw_json=params.data_path, out_dir=params.processed_data_path, clear=clear
+    )
+    labels_counter, labels, (train_indexes, train_labels, test_indexes, test_labels) = (
+        preprocessor()
+    )
+
+    if getattr(params, "train_label_weights", False):
+        label_weights = np.asarray(
+            [1 / labels_counter[k] for k in sorted(labels_counter.keys())]
+        )
+        label_weights = label_weights / np.sum(label_weights)
+        logger.info(
+            "Label weights: "
+            + ", ".join(
+                f"{RawPreprocessor.id2labels[k]} ({k}) - {v:.4f}"
+                for k, v in enumerate(label_weights)
+            )
+            + "."
+        )
+        weights["label_weights"] = label_weights
+
+    if getattr(params, "train_sampler_weights", False):
+        sampler_weights = np.asarray([1 / labels_counter[label] for label in train_labels])
+        weights["sampler_weights"] = sampler_weights / np.sum(sampler_weights)
+
+    common = dict(
+        tokenizer=tokenizer,
+        max_seq_len=params.max_seq_len,
+        max_question_len=params.max_question_len,
+        doc_stride=params.doc_stride,
+        split_by_sentence=params.split_by_sentence,
+        truncate=params.truncate,
+        rng=rng,
+    )
+    train_dataset = SplitDataset(params.processed_data_path, indexes=train_indexes, **common)
+    test_dataset = SplitDataset(
+        params.processed_data_path, indexes=test_indexes, test=True, **common
+    )
+
+    return train_dataset, test_dataset, weights
+
+
+def init_validation_dataset(params, *, tokenizer=None, clear: bool = False, rng=None):
+    """Held-out split as a ChunkDataset (reference validate.py:15-26)."""
+    preprocessor = RawPreprocessor(
+        raw_json=params.data_path, out_dir=params.processed_data_path, clear=clear
+    )
+    _, _, (_, _, val_indexes, val_labels) = preprocessor()
+
+    return ChunkDataset(
+        params.processed_data_path,
+        tokenizer,
+        val_indexes,
+        test=False,
+        split_by_sentence=True,
+        truncate=True,
+        rng=rng,
+    )
+
+
+def init_collate_fun(tokenizer, *, max_seq_len: Optional[int] = None, return_items: bool = False):
+    """Bind tokenizer + static shape (init.py:204-205; fixed-shape TPU delta)."""
+    return functools.partial(
+        collate_fun, tokenizer=tokenizer, max_seq_len=max_seq_len, return_items=return_items
+    )

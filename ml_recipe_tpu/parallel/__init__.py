@@ -1,6 +1,7 @@
 from .mesh import MeshSpec, build_mesh, local_device_count
 from .dist import (
     initialize_distributed,
+    initialize_from_params,
     initialize_from_env,
     barrier,
     process_index,
@@ -26,6 +27,7 @@ __all__ = [
     "build_mesh",
     "local_device_count",
     "initialize_distributed",
+    "initialize_from_params",
     "initialize_from_env",
     "barrier",
     "process_index",

@@ -72,11 +72,16 @@ def build_mesh(
         mesh_spec = MeshSpec.from_string(spec, n_devices=len(devices))
 
     ordered = mesh_spec.ordered()
-    if mesh_spec.size != len(devices):
+    if mesh_spec.size > len(devices):
         raise ValueError(
             f"Mesh axes {ordered} require {mesh_spec.size} devices, "
             f"but {len(devices)} are visible."
         )
+    if mesh_spec.size < len(devices):
+        logger.info(
+            f"Mesh uses the first {mesh_spec.size} of {len(devices)} visible devices."
+        )
+        devices = devices[: mesh_spec.size]
 
     device_array = np.asarray(devices).reshape(tuple(ordered.values()))
     mesh = Mesh(device_array, axis_names=tuple(ordered.keys()))

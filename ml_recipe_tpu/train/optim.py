@@ -202,13 +202,17 @@ def build_optimizer(
     *,
     num_training_steps: int,
     max_grad_norm: Optional[float] = None,
+    warmup_coef: Optional[float] = None,
 ) -> tuple:
     """Optimizer selection + schedule (reference init.py:134-145 +
     trainer.py:116-126 + clip trainer.py:221-225 fused into one chain).
 
-    Returns ``(optax transform, schedule_fn)``.
+    Returns ``(optax transform, schedule_fn)``. ``warmup_coef``, when given,
+    overrides ``trainer_params.warmup_coef`` (the Trainer field is the single
+    source of truth when built through the Trainer).
     """
-    warmup_coef = getattr(trainer_params, "warmup_coef", 0.0)
+    if warmup_coef is None:
+        warmup_coef = getattr(trainer_params, "warmup_coef", 0.0)
     lr = trainer_params.lr
 
     if warmup_coef and warmup_coef > 0:

@@ -167,6 +167,18 @@ class Trainer:
         self.opt_state = None
         self.scheduler = None
         if self.train_dataloader is not None and self.trainer_params is not None:
+            micro_batch = self.train_batch_size // self.batch_split
+            data_size = int(
+                self.mesh.shape.get("data", 1) if hasattr(self.mesh, "shape") else 1
+            )
+            if micro_batch % max(data_size, 1) != 0:
+                raise ValueError(
+                    f"Micro-batch {micro_batch} (train_batch_size "
+                    f"{self.train_batch_size} / batch_split {self.batch_split}) "
+                    f"must divide over the {data_size}-way mesh data axis; "
+                    f"lower batch_split or raise train_batch_size."
+                )
+
             steps_per_epoch = len(self.train_dataloader)
             num_training_steps = max(self.n_epochs * steps_per_epoch, 1)
             if self.warmup_coef > 0:
@@ -179,6 +191,7 @@ class Trainer:
                 self.params,
                 num_training_steps=num_training_steps,
                 max_grad_norm=self.max_grad_norm,
+                warmup_coef=self.warmup_coef,
             )
             # jit so opt-state leaves inherit the param shardings (GSPMD
             # propagation) instead of landing unsharded on device 0.

@@ -1,0 +1,124 @@
+"""Composition-root + CLI-entry tests (reference init.py / train.py parity)."""
+
+import numpy as np
+import pytest
+
+from ml_recipe_tpu.compose import (
+    init_collate_fun,
+    init_datasets,
+    init_loss,
+    init_model,
+    init_tokenizer,
+)
+from ml_recipe_tpu.config.parser import get_model_parser, get_params, get_trainer_parser
+
+from helpers import make_tokenizer, nq_line, write_corpus, write_vocab
+
+
+def _model_params(tmp_path, **over):
+    parser = get_model_parser()
+    ns, _ = parser.parse_known_args([])
+    ns.vocab_file = str(write_vocab(tmp_path))
+    ns.lowercase = True
+    for k, v in over.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def _trainer_params(**over):
+    parser = get_trainer_parser()
+    ns, _ = parser.parse_known_args([])
+    for k, v in over.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def test_init_tokenizer_first_party(tmp_path):
+    tok = init_tokenizer(_model_params(tmp_path))
+    assert tok.model_name == "bert"
+    assert tok.pad_token_id == 0
+
+
+def test_init_tokenizer_missing_vocab_raises(tmp_path):
+    mp = _model_params(tmp_path)
+    mp.vocab_file = None
+    with pytest.raises(RuntimeError, match="vocab_file"):
+        init_tokenizer(mp)
+
+
+def test_init_tokenizer_bad_vocab_path_fails_fast(tmp_path):
+    mp = _model_params(tmp_path)
+    mp.vocab_file = str(tmp_path / "nope.txt")
+    with pytest.raises(FileNotFoundError, match="nope.txt"):
+        init_tokenizer(mp)
+
+
+def test_init_model_tiny(tmp_path):
+    # full bert-base init is slow on CPU; just check the contract wires up
+    mp = _model_params(tmp_path)
+    model, params, tok = init_model(mp, rng_seed=0)
+    assert "transformer" in params
+    assert {"position_outputs", "classifier", "reg_start", "reg_end"} <= set(params.keys())
+
+
+def test_init_datasets_dummy(tmp_path):
+    tok = make_tokenizer(tmp_path)
+    params = _trainer_params(dummy_dataset=True, max_seq_len=48, max_question_len=12)
+    train_ds, test_ds, weights = init_datasets(params, tokenizer=tok)
+    assert len(train_ds) == 10000
+    assert len(test_ds) == 1024
+    assert weights["label_weights"] is None and weights["sampler_weights"] is None
+    item = train_ds[0]
+    assert len(item.input_ids) <= 48
+
+
+def test_init_datasets_real_with_weights(tmp_path):
+    tok = make_tokenizer(tmp_path)
+    corpus = write_corpus(
+        tmp_path,
+        [nq_line(example_id=str(i)) for i in range(20)],
+    )
+    params = _trainer_params(
+        dummy_dataset=False,
+        data_path=str(corpus),
+        processed_data_path=str(tmp_path / "processed"),
+        max_seq_len=64,
+        max_question_len=16,
+        doc_stride=16,
+        split_by_sentence=False,
+        truncate=True,
+        train_label_weights=True,
+        train_sampler_weights=True,
+    )
+    train_ds, test_ds, weights = init_datasets(params, tokenizer=tok)
+    assert len(train_ds) + len(test_ds) == 20
+    assert weights["label_weights"] is not None
+    assert weights["sampler_weights"] is not None
+    assert len(weights["sampler_weights"]) == len(train_ds)
+    np.testing.assert_allclose(np.sum(weights["sampler_weights"]), 1.0)
+
+    loss = init_loss(params, weights)
+    assert set(loss.keys) == {"start_class", "end_class", "start_reg", "end_reg", "cls"}
+
+    collate = init_collate_fun(tok, max_seq_len=64)
+    inputs, labels = collate([train_ds[0], train_ds[1]])
+    assert inputs["input_ids"].shape == (2, 64)
+
+
+def test_cli_parsers_roundtrip(tmp_path):
+    """The reference routing trick: one cfg feeds both parsers; keys neither
+    recognises error out (parser.py:9-31)."""
+    cfg = tmp_path / "t.cfg"
+    cfg.write_text("model=bert-base-uncased\nn_epochs=3\nlr=2e-5\ndebug=True\n")
+    (parsers, (trainer_ns, model_ns)) = get_params(
+        (get_trainer_parser, get_model_parser), ["-c", str(cfg)]
+    )
+    assert trainer_ns.n_epochs == 3
+    assert trainer_ns.lr == 2e-5
+    assert trainer_ns.debug is True
+    assert model_ns.model == "bert-base-uncased"
+
+    cfg2 = tmp_path / "bad.cfg"
+    cfg2.write_text("model=bert-base-uncased\nnot_a_flag=1\n")
+    with pytest.raises(SystemExit):
+        get_params((get_trainer_parser, get_model_parser), ["-c", str(cfg2)])

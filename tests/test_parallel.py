@@ -39,9 +39,15 @@ def test_build_mesh_2d(eight_devices):
     assert mesh.shape["model"] == 2
 
 
-def test_build_mesh_wrong_size(eight_devices):
+def test_build_mesh_too_large(eight_devices):
     with pytest.raises(ValueError):
-        build_mesh("data:3")
+        build_mesh("data:16")
+
+
+def test_build_mesh_subset(eight_devices):
+    # smaller specs take the first N devices (single-chip eval on a pod host)
+    mesh = build_mesh("data:2")
+    assert mesh.shape == {"data": 2}
 
 
 def test_param_pspecs_tp(eight_devices):
