@@ -1,0 +1,133 @@
+"""Benchmark: bert-base QA fine-tune throughput (examples/sec/chip).
+
+Measures the REAL training step the framework ships — the Trainer's jitted
+SPMD step (forward + 5-head WeightedLoss + grad + clip + AdamW + schedule) at
+the reference smoke-config shape (bert-base, seq 512, global batch 256,
+config/test_bert.cfg parity) on whatever chips are visible.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "examples/sec/chip", "vs_baseline": N}
+
+``vs_baseline`` is relative to a nominal single-V100 bert-base fine-tune
+throughput (~100 ex/s at seq 384-512, fp16 — the reference publishes no
+numbers, BASELINE.md:5; the driver's north star is >=3x single-V100).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+V100_EXAMPLES_PER_SEC_EST = 100.0  # nominal single-V100 bert-base QA fine-tune
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--seq_len", type=int, default=512)
+    parser.add_argument("--global_batch", type=int, default=256)
+    parser.add_argument("--batch_split", type=int, default=8)
+    parser.add_argument("--steps", type=int, default=12)
+    parser.add_argument("--warmup", type=int, default=2)
+    parser.add_argument("--model", type=str, default="bert-base-uncased")
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from ml_recipe_tpu.losses import build_loss
+    from ml_recipe_tpu.models import MODEL_PRESETS, QAModel
+    from ml_recipe_tpu.parallel import build_mesh
+    from ml_recipe_tpu.train import Trainer
+
+    n_chips = len(jax.devices())
+    mesh = build_mesh()
+
+    cfg = MODEL_PRESETS[args.model]
+    model = QAModel(cfg, dtype=jnp.bfloat16, attention_impl="auto")
+
+    class TP:
+        loss = "smooth"; smooth_alpha = 0.01; focal_alpha = 1; focal_gamma = 2
+        w_start = 1; w_end = 1; w_start_reg = 1; w_end_reg = 1; w_cls = 1
+        lr = 1e-5; weight_decay = 1e-4; warmup_coef = 0.0
+        optimizer = "adam"; finetune = False
+
+    rng = np.random.default_rng(0)
+    B, L = args.global_batch, args.seq_len
+    params = model.init(
+        jax.random.key(0), np.zeros((1, 8), dtype=np.int32)
+    )["params"]
+
+    trainer = Trainer(
+        model=model, params=params, loss=build_loss(TP()),
+        collate_fun=None, trainer_params=None,  # step built manually below
+        mesh=mesh, batch_split=args.batch_split, seed=0,
+    )
+    # test-only Trainer skips optimizer construction; build it for the bench
+    from ml_recipe_tpu.train.optim import build_optimizer
+
+    trainer.optimizer, trainer.scheduler = build_optimizer(
+        TP(), trainer.params, num_training_steps=10_000, max_grad_norm=1.0,
+        warmup_coef=0.0,
+    )
+    trainer.opt_state = jax.jit(trainer.optimizer.init)(trainer.params)
+    step_fn = trainer._build_train_step()
+
+    G = args.batch_split
+    host_inputs = {
+        "input_ids": rng.integers(1, cfg.vocab_size, (G, B // G, L)).astype(np.int32),
+        "attention_mask": np.ones((G, B // G, L), dtype=np.int32),
+        "token_type_ids": np.zeros((G, B // G, L), dtype=np.int32),
+    }
+    host_labels = {
+        "start_class": rng.integers(0, L, (G, B // G)).astype(np.int32),
+        "end_class": rng.integers(0, L, (G, B // G)).astype(np.int32),
+        "start_reg": rng.random((G, B // G)).astype(np.float32),
+        "end_reg": rng.random((G, B // G)).astype(np.float32),
+        "cls": rng.integers(0, 5, (G, B // G)).astype(np.int32),
+    }
+
+    with mesh:
+        inputs = trainer._global_batch(host_inputs, leading_accum=True)
+        labels = trainer._global_batch(host_labels, leading_accum=True)
+
+        params_d, opt_d = trainer.params, trainer.opt_state
+        for i in range(args.warmup):
+            params_d, opt_d, values = step_fn(params_d, opt_d, inputs, labels, i)
+        # sync via a host fetch: block_until_ready does NOT actually block
+        # through the tunneled single-chip backend
+        float(values["loss"])
+
+        t0 = time.perf_counter()
+        for i in range(args.steps):
+            params_d, opt_d, values = step_fn(
+                params_d, opt_d, inputs, labels, args.warmup + i
+            )
+        final_loss = float(values["loss"])
+        elapsed = time.perf_counter() - t0
+
+    step_time_ms = elapsed / args.steps * 1000.0
+    examples_per_sec = args.global_batch * args.steps / elapsed
+    per_chip = examples_per_sec / n_chips
+
+    print(
+        json.dumps(
+            {
+                "metric": f"{args.model}_qa_finetune_seq{L}_examples_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "examples/sec/chip",
+                "vs_baseline": round(per_chip / V100_EXAMPLES_PER_SEC_EST, 3),
+                "step_time_ms": round(step_time_ms, 1),
+                "global_batch": args.global_batch,
+                "n_chips": n_chips,
+                "backend": jax.default_backend(),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,8 +70,31 @@ def param_pspecs(params, mesh: Mesh) -> dict:
     return jax.tree_util.tree_map_with_path(spec_for, params)
 
 
+def is_single_device(mesh: Mesh) -> bool:
+    """True when the mesh is one device — GSPMD placement is skipped entirely
+    then: COMMITTED arrays (NamedSharding or explicit device) force a compile/
+    dispatch path that is a measured ~120-200x slowdown on the tunneled
+    single-chip 'axon' TPU backend, and buy nothing without peers."""
+    return mesh.devices.size == 1
+
+
+def put_single(x, mesh: Mesh):
+    """Single-device placement that avoids committing when possible.
+
+    Uncommitted device_put keeps the fast non-partitioned dispatch path; an
+    explicit device target is only used when the mesh is pinned to a device
+    other than the process default (where correctness requires commitment).
+    """
+    device = mesh.devices.flat[0]
+    if device == jax.devices()[0]:
+        return jax.device_put(x)
+    return jax.device_put(x, device)
+
+
 def shard_params(params, mesh: Mesh, pspecs: Optional[dict] = None):
     """Place a param tree onto the mesh with the given (or derived) specs."""
+    if is_single_device(mesh):
+        return jax.tree_util.tree_map(lambda x: put_single(x, mesh), params)
     if pspecs is None:
         pspecs = param_pspecs(params, mesh)
     return jax.tree_util.tree_map(
@@ -110,6 +133,11 @@ def make_global_array(
     ``batch_axis`` selects which dim is sharded over ``data`` (axis 1 for
     micro-batch-major [G, B, ...] layouts used by in-step grad accumulation).
     """
+    if is_single_device(mesh):
+        return jax.tree_util.tree_map(
+            lambda x: put_single(np.asarray(x), mesh), host_batch
+        )
+
     def to_global(x):
         x = np.asarray(x)
         if batch_axis == 0:
