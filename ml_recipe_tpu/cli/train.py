@@ -65,6 +65,7 @@ def run_worker(params, model_params) -> None:
     model, model_state, tokenizer = init_model(
         model_params, bpe_dropout=params.bpe_dropout,
         rng_seed=params.seed if params.seed is not None else 0,
+        mesh=mesh,
     )
 
     # Rank 0 prepares the (shared-dir) dataset; everyone else waits, then

@@ -94,6 +94,7 @@ def init_model(
     checkpoint: Optional[str] = None,
     bpe_dropout: Optional[float] = None,
     rng_seed: int = 0,
+    mesh=None,
 ) -> Tuple[QAModel, dict, object]:
     """Build (model, params, tokenizer) — reference init.py:51-82.
 
@@ -113,6 +114,7 @@ def init_model(
         dtype=dtype,
         attention_impl=attention_impl,
         remat=getattr(model_params, "remat", False),
+        mesh=mesh,  # required by attention_impl='ring' (sequence parallelism)
     )
 
     example = np.zeros((1, 8), dtype=np.int32)

@@ -257,9 +257,10 @@ def get_model_parser() -> ConfigArgumentParser:
                         help="Activation/matmul dtype (native mixed precision; "
                              "replaces Apex AMP levels).")
     parser.add_argument("--flash_attention", type=cast2(str), default="auto",
-                        choices=[None, "auto", "pallas", "xla"],
+                        choices=[None, "auto", "pallas", "xla", "ring"],
                         help="Attention implementation: pallas kernel, plain XLA, "
-                             "or auto (pallas on TPU).")
+                             "auto (pallas on TPU when shapes/dropout allow), or "
+                             "ring (sequence-parallel over the mesh 'seq' axis).")
     parser.add_argument("--remat", action="store_true",
                         help="Rematerialize encoder layers (jax.checkpoint) to trade "
                              "FLOPs for HBM.")

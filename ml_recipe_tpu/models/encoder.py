@@ -16,7 +16,7 @@ post-layer-norm BERT stack; differences from a naive port are TPU-driven:
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -59,6 +59,7 @@ class SelfAttention(nn.Module):
     cfg: EncoderConfig
     dtype: jnp.dtype = jnp.float32
     attention_impl: str = "xla"
+    mesh: Any = None  # required by impl='ring' (sequence parallelism)
 
     @nn.compact
     def __call__(self, hidden, mask, *, deterministic: bool):
@@ -81,6 +82,7 @@ class SelfAttention(nn.Module):
             dropout_rng=dropout_rng,
             dtype=self.dtype,
             impl=self.attention_impl,
+            mesh=self.mesh,
         )
         ctx = ctx.reshape(B, L, cfg.hidden_size)
 
@@ -109,11 +111,13 @@ class EncoderLayer(nn.Module):
     cfg: EncoderConfig
     dtype: jnp.dtype = jnp.float32
     attention_impl: str = "xla"
+    mesh: Any = None
 
     @nn.compact
     def __call__(self, hidden, mask, deterministic: bool = True):
         hidden = SelfAttention(self.cfg, self.dtype, self.attention_impl,
-                               name="attention")(hidden, mask, deterministic=deterministic)
+                               self.mesh, name="attention")(hidden, mask,
+                               deterministic=deterministic)
         hidden = FeedForward(self.cfg, self.dtype, name="mlp")(
             hidden, deterministic=deterministic
         )
@@ -127,6 +131,7 @@ class TransformerEncoder(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attention_impl: str = "xla"
     remat: bool = False
+    mesh: Any = None
 
     @nn.compact
     def __call__(
@@ -152,7 +157,7 @@ class TransformerEncoder(nn.Module):
             layer_cls = nn.remat(EncoderLayer, static_argnums=(3,))
 
         for i in range(cfg.num_layers):
-            hidden = layer_cls(cfg, self.dtype, self.attention_impl,
+            hidden = layer_cls(cfg, self.dtype, self.attention_impl, self.mesh,
                                name=f"layer_{i}")(hidden, attention_mask, deterministic)
 
         pooled = nn.Dense(cfg.hidden_size, name="pooler", dtype=self.dtype)(hidden[:, 0])

@@ -17,6 +17,8 @@ behaviour under fixed shapes.
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -33,6 +35,7 @@ class QAModel(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attention_impl: str = "xla"
     remat: bool = False
+    mesh: Any = None  # required by attention_impl='ring'
 
     @nn.compact
     def __call__(
@@ -48,7 +51,8 @@ class QAModel(nn.Module):
             attention_mask = jnp.ones_like(input_ids)
 
         sequence_output, pooled_output = TransformerEncoder(
-            cfg, self.dtype, self.attention_impl, self.remat, name="transformer"
+            cfg, self.dtype, self.attention_impl, self.remat, self.mesh,
+            name="transformer"
         )(
             input_ids,
             attention_mask=attention_mask,

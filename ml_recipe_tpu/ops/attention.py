@@ -61,28 +61,67 @@ def dot_product_attention(
     dropout_rng=None,
     dtype=jnp.float32,
     impl: str = "auto",
+    mesh=None,
 ) -> jnp.ndarray:
-    """Multi-head attention over [B, L, H, D] tensors with a [B, L] key mask."""
+    """Multi-head attention over [B, L, H, D] tensors with a [B, L] key mask.
+
+    ``impl='ring'`` runs sequence-parallel ring attention over the mesh
+    ``seq`` axis (requires ``mesh``; composes with the ``data`` axis).
+    """
+    if impl == "ring":
+        from ..parallel.sharding import DATA_AXIS, SEQ_AXIS
+        from .ring_attention import ring_attention
+
+        assert mesh is not None, "impl='ring' requires a mesh"
+        assert SEQ_AXIS in mesh.axis_names and mesh.shape[SEQ_AXIS] > 1, (
+            f"impl='ring' needs a '{SEQ_AXIS}' mesh axis > 1 "
+            f"(--mesh 'data:N,seq:M'); got {dict(zip(mesh.axis_names, mesh.devices.shape))}"
+        )
+        batch_axis = (
+            DATA_AXIS
+            if DATA_AXIS in mesh.axis_names and mesh.shape[DATA_AXIS] > 1
+            else None
+        )
+        if dropout_rate > 0.0:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "ring attention has no attention-dropout path; dropout skipped."
+            )
+        return ring_attention(
+            q, k, v, mask, mesh=mesh, axis_name=SEQ_AXIS,
+            batch_axis=batch_axis, dtype=dtype,
+        )
+
     if impl == "auto":
+        from .flash_attention import _pick_q_block
+
         use_pallas = (
             jax.default_backend() == "tpu"
             and dropout_rate == 0.0
-            and q.shape[1] % 128 == 0
-            and q.shape[-1] % 128 == 0
+            and _pick_q_block(q.shape[1]) is not None
         )
         impl = "pallas" if use_pallas else "xla"
 
     if impl == "pallas":
-        try:
-            from .flash_attention import flash_attention
-        except ImportError:  # kernel unavailable on this build — fall back
+        if dropout_rate > 0.0:
             import logging
 
             logging.getLogger(__name__).warning(
-                "Pallas flash-attention kernel unavailable; falling back to XLA."
+                "Pallas flash-attention has no dropout path; using XLA "
+                "attention so attention-dropout regularization is preserved."
             )
         else:
-            return flash_attention(q, k, v, mask, dtype=dtype)
+            try:
+                from .flash_attention import flash_attention
+            except ImportError:  # kernel unavailable on this build — fall back
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "Pallas flash-attention kernel unavailable; falling back to XLA."
+                )
+            else:
+                return flash_attention(q, k, v, mask, dtype=dtype)
 
     return _xla_attention(
         q, k, v, mask, dropout_rate=dropout_rate, dropout_rng=dropout_rng, dtype=dtype

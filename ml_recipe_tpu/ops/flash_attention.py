@@ -1,0 +1,139 @@
+"""Fused attention Pallas TPU kernel.
+
+Replaces the HF/CUDA attention internals of the reference's BertModel trunk
+(SURVEY.md §2.2) with a first-party kernel. For BERT-class sequence lengths
+(<= 2k) the whole K/V for one (batch, head) fits in VMEM, so the kernel is an
+*exact* fused softmax-attention: scores for one query block are computed,
+softmaxed and contracted against V entirely on-chip — the [B, H, L, L] score
+tensor never exists in HBM (that tensor is the HBM-bandwidth bottleneck of
+the naive path).
+
+Layout: q/k/v arrive as [B, L, H, D] (the encoder's natural layout — no
+transposes inserted). Grid is (B, H, L/q_blk); each program computes one
+query block against the full keys.
+
+Backward: the kernel carries a ``jax.custom_vjp`` whose backward pass
+recomputes attention with the XLA einsum path and differentiates that —
+forward (the inference/serving hot path and 1/3 of training FLOPs) runs the
+kernel, gradients stay exact.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _attention_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, *, scale: float):
+    """One (batch, head, q-block) program: softmax(q k^T) v, fully in VMEM.
+
+    Block shapes (leading singleton dims indexed away by the grid; inputs are
+    pre-transposed to [B, H, L, D] so the trailing block dims [q_blk/L, D]
+    satisfy the TPU (8, 128)-or-equal tiling rule):
+      q_ref: [1, 1, q_blk, D]; k_ref/v_ref: [1, 1, L, D]; mask_ref: [1, 1, L]
+      o_ref: [1, 1, q_blk, D]
+    """
+    q = q_ref[0, 0, :, :]  # [q_blk, D]
+    k = k_ref[0, 0, :, :]  # [L, D]
+    v = v_ref[0, 0, :, :]  # [L, D]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [q_blk, L] in f32 on the MXU
+    s = s * scale
+
+    mask = mask_ref[0, 0, :]  # [L]
+    s = jnp.where(mask[None, :] > 0, s, _NEG_INF)
+
+    # numerically-stable softmax in f32 on the VPU
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    p = p / denom
+
+    o = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [q_blk, D]
+    o_ref[0, 0, :, :] = o.astype(o_ref.dtype)
+
+
+def _pick_q_block(L: int) -> Optional[int]:
+    for blk in (512, 256, 128):
+        if L % blk == 0:
+            return blk
+    if L <= 512:
+        return L  # single block
+    return None
+
+
+def _flash_forward(q, k, v, mask, dtype, interpret: bool = False):
+    B, L, H, D = q.shape
+    q_blk = _pick_q_block(L)
+    assert q_blk is not None, f"unsupported sequence length {L}"
+
+    scale = 1.0 / (D ** 0.5)
+    grid = (B, H, L // q_blk)
+
+    kernel = functools.partial(_attention_kernel, scale=scale)
+
+    # [B, L, H, D] -> [B, H, L, D]: trailing block dims become [len, D],
+    # satisfying the TPU tile rule; XLA fuses the transposes into the
+    # surrounding projection matmuls.
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    mask3 = mask[:, None, :]
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, L), lambda b, h, qi: (b, 0, 0)),          # mask
+            pl.BlockSpec((1, 1, q_blk, D), lambda b, h, qi: (b, h, qi, 0)),  # q
+            pl.BlockSpec((1, 1, L, D), lambda b, h, qi: (b, h, 0, 0)),       # k
+            pl.BlockSpec((1, 1, L, D), lambda b, h, qi: (b, h, 0, 0)),       # v
+        ],
+        out_specs=pl.BlockSpec((1, 1, q_blk, D), lambda b, h, qi: (b, h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, L, D), dtype),
+        interpret=interpret,
+    )(mask3, qt, kt, vt)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def _xla_reference(q, k, v, mask, dtype):
+    """Einsum attention used for the backward pass — the dispatcher's XLA
+    path itself, so forward-kernel and backward semantics cannot drift."""
+    from .attention import _xla_attention
+
+    return _xla_attention(q, k, v, mask, dtype=dtype).astype(dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def flash_attention(q, k, v, mask, dtype=jnp.float32, interpret=False):
+    """Fused attention over [B, L, H, D] with a [B, L] key-validity mask."""
+    if mask is None:
+        mask = jnp.ones(q.shape[:2], dtype=jnp.int32)
+    return _flash_forward(q, k, v, mask, dtype, interpret)
+
+
+def _fwd(q, k, v, mask, dtype, interpret):
+    out = flash_attention(q, k, v, mask, dtype, interpret)
+    return out, (q, k, v, mask)
+
+
+def _bwd(dtype, interpret, residuals, g):
+    q, k, v, mask = residuals
+    _, vjp = jax.vjp(lambda q_, k_, v_: _xla_reference(q_, k_, v_, mask, dtype), q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+flash_attention.defvjp(_fwd, _bwd)

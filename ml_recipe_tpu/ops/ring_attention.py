@@ -1,0 +1,120 @@
+"""Ring attention — sequence/context parallelism over the mesh ``seq`` axis.
+
+No reference counterpart: the reference handles long documents purely by
+data-level chunking (SURVEY.md §2.3 — sliding windows at
+split_dataset.py:287-306). This op is the attention-level scale-out the TPU
+framework adds: the sequence dimension is sharded over the ``seq`` mesh axis,
+each device holds its local Q/K/V slice, and K/V blocks rotate around the
+ring via ``ppermute`` while an online-softmax accumulator builds the exact
+global attention — memory per device is O(L_local · L_local) instead of
+O(L · L), and the K/V transfers ride the ICI ring concurrently with compute.
+
+Algorithm: blockwise attention with running (max, denom, out) renormalisation
+(Liu et al., "Ring Attention with Blockwise Transformers", arXiv 2310.01889 —
+see PAPERS.md; implementation is original, written against the math).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _ring_attention_local(q, k, v, mask, *, axis_name: str, scale: float):
+    """Per-shard body (runs under shard_map).
+
+    q/k/v: [B, L_loc, H, D] local slices; mask: [B, L_loc] key validity.
+    Returns [B, L_loc, H, D] — the exact softmax(QK^T)V rows for local Q
+    against the FULL global K/V.
+    """
+    n_shards = jax.lax.psum(1, axis_name)
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+    B, L_loc, H, D = q.shape
+
+    def block_scores(k_blk, mask_blk):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
+        return jnp.where(mask_blk[:, None, None, :] > 0, s, _NEG_INF)
+
+    def accumulate(carry, k_cur, v_cur, mask_cur):
+        o_acc, m_acc, l_acc = carry
+
+        s = block_scores(k_cur, mask_cur)                      # [B,H,Lq,Lk]
+        m_blk = jnp.max(s, axis=-1)                            # [B,H,Lq]
+        m_new = jnp.maximum(m_acc, m_blk)
+        p = jnp.exp(s - m_new[..., None])                      # [B,H,Lq,Lk]
+        corr = jnp.exp(m_acc - m_new)                          # [B,H,Lq]
+
+        l_new = l_acc * corr + jnp.sum(p, axis=-1)
+        o_blk = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_cur.dtype), v_cur)
+        o_new = o_acc * corr.transpose(0, 2, 1)[..., None] + o_blk.astype(jnp.float32)
+        return o_new, m_new, l_new
+
+    def body(i, carry):
+        acc, k_cur, v_cur, mask_cur = carry
+        acc = accumulate(acc, k_cur, v_cur, mask_cur)
+        # rotate K/V/mask one step around the ring (ICI neighbour copy,
+        # overlapped with the next block's compute by the scheduler)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        mask_nxt = jax.lax.ppermute(mask_cur, axis_name, perm)
+        return acc, k_nxt, v_nxt, mask_nxt
+
+    o0 = jnp.zeros((B, L_loc, H, D), jnp.float32)
+    m0 = jnp.full((B, H, L_loc), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, L_loc), jnp.float32)
+
+    # first n_shards-1 blocks rotate after accumulating; the final block
+    # accumulates only — no wasted trailing ring transfer
+    acc, k_last, v_last, mask_last = jax.lax.fori_loop(
+        0, n_shards - 1, body, ((o0, m0, l0), k, v, mask)
+    )
+    o, m, l = accumulate(acc, k_last, v_last, mask_last)
+
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]  # [B,Lq,H,1]
+    return (o / denom).astype(q.dtype)
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    mask=None,
+    *,
+    mesh: Mesh,
+    axis_name: str = "seq",
+    batch_axis: Optional[str] = None,
+    dtype=jnp.float32,
+):
+    """Exact global attention with Q/K/V sharded over ``axis_name``.
+
+    Inputs are GLOBAL [B, L, H, D] arrays (sharded or not — shard_map
+    partitions them); output is the global [B, L, H, D] attention result,
+    sequence-sharded the same way. ``batch_axis`` names the mesh axis the
+    batch dim is data-parallel over (composes dp x sp inside one jitted
+    step); None replicates over any remaining axes.
+    """
+    if mask is None:
+        mask = jnp.ones(q.shape[:2], dtype=jnp.int32)
+
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    fn = functools.partial(
+        _ring_attention_local, axis_name=axis_name, scale=scale
+    )
+
+    seq_spec = P(batch_axis, axis_name, None, None)
+    mask_spec = P(batch_axis, axis_name)
+
+    return jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(seq_spec, seq_spec, seq_spec, mask_spec),
+        out_specs=seq_spec,
+        check_vma=False,
+    )(q.astype(dtype), k.astype(dtype), v.astype(dtype), mask)

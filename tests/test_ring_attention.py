@@ -1,0 +1,120 @@
+"""Ring attention tests on the 8-device CPU mesh: exactness vs full attention."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ml_recipe_tpu.ops.flash_attention import _xla_reference
+from ml_recipe_tpu.ops.ring_attention import ring_attention
+from ml_recipe_tpu.parallel import build_mesh
+
+
+def _qkv(B=2, L=64, H=4, D=16, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(B, L, H, D)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+def test_ring_matches_full_attention():
+    mesh = build_mesh("seq:8")
+    q, k, v = _qkv()
+    out_ring = ring_attention(q, k, v, mesh=mesh)
+    out_full = _xla_reference(q, k, v, None, jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(out_ring), np.asarray(out_full), atol=1e-5
+    )
+
+
+def test_ring_with_padding_mask():
+    mesh = build_mesh("seq:8")
+    q, k, v = _qkv()
+    mask = np.ones((2, 64), np.int32)
+    mask[0, 40:] = 0  # padding spans shard boundaries (40 = 5 shards of 8)
+    mask = jnp.asarray(mask)
+
+    out_ring = ring_attention(q, k, v, mask, mesh=mesh)
+    out_full = _xla_reference(q, k, v, mask, jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(out_ring), np.asarray(out_full), atol=1e-5
+    )
+
+
+def test_ring_on_2d_mesh_with_data_axis():
+    """seq parallelism composes with data parallelism (data:2, seq:4)."""
+    mesh = build_mesh("data:2,seq:4")
+    q, k, v = _qkv(B=4, L=32)
+    out_ring = ring_attention(q, k, v, mesh=mesh)
+    out_full = _xla_reference(q, k, v, None, jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(out_ring), np.asarray(out_full), atol=1e-5
+    )
+
+
+def test_ring_inside_jit():
+    """ring_attention must compose with an outer jit (the train step)."""
+    mesh = build_mesh("seq:8")
+    q, k, v = _qkv()
+
+    @jax.jit
+    def f(q, k, v):
+        return ring_attention(q, k, v, mesh=mesh).sum()
+
+    full = _xla_reference(q, k, v, None, jnp.float32).sum()
+    np.testing.assert_allclose(float(f(q, k, v)), float(full), rtol=1e-5)
+
+
+def test_ring_gradients_match():
+    mesh = build_mesh("seq:8")
+    q, k, v = _qkv(L=32)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh=mesh) ** 2)
+
+    def loss_full(q, k, v):
+        return jnp.sum(_xla_reference(q, k, v, None, jnp.float32) ** 2)
+
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_qa_model_ring_attention_end_to_end():
+    """Full QAModel forward with sequence-parallel attention on a dp x sp mesh
+    matches the XLA-attention model, with inputs sharded over both axes."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ml_recipe_tpu.models import EncoderConfig, QAModel
+
+    mesh = build_mesh("data:2,seq:4")
+    cfg = EncoderConfig(
+        vocab_size=100, hidden_size=32, num_layers=2, num_heads=4,
+        intermediate_size=64, max_position_embeddings=64,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+    )
+    B, L = 4, 32
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 100, (B, L)).astype(np.int32)
+    mask = np.ones((B, L), np.int32)
+    mask[0, 20:] = 0
+
+    model_ring = QAModel(cfg, attention_impl="ring", mesh=mesh)
+    model_xla = QAModel(cfg, attention_impl="xla")
+    params = model_xla.init(jax.random.key(0), ids, mask)["params"]
+
+    with mesh:
+        sharded = lambda x: jax.device_put(
+            x, NamedSharding(mesh, P("data", "seq"))
+        )
+        out_ring = jax.jit(
+            lambda p, i, m: model_ring.apply({"params": p}, i, m, deterministic=True)
+        )(params, sharded(ids), sharded(mask))
+        out_xla = model_xla.apply({"params": params}, ids, mask, deterministic=True)
+
+    for key in out_xla:
+        np.testing.assert_allclose(
+            np.asarray(out_ring[key]), np.asarray(out_xla[key]),
+            atol=2e-4, err_msg=key,
+        )
