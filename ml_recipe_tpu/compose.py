@@ -15,6 +15,7 @@ the step is compiled (it needs ``num_training_steps`` for the schedule).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import logging
 import os
@@ -118,7 +119,15 @@ def init_model(
     )
 
     example = np.zeros((1, 8), dtype=np.int32)
-    params = model.init(jax.random.key(rng_seed), example)["params"]
+    # Init through an XLA-attention twin: param structure is identical across
+    # attention impls, and ring's shard_map would reject the tiny example
+    # shape (batch/seq not divisible by the mesh axes).
+    init_module = (
+        dataclasses.replace(model, attention_impl="xla", mesh=None)
+        if model.attention_impl == "ring"
+        else model
+    )
+    params = init_module.init(jax.random.key(rng_seed), example)["params"]
 
     hf_checkpoint = getattr(model_params, "hf_checkpoint", None)
     if hf_checkpoint:

@@ -108,3 +108,96 @@ def barrier(name: str = "barrier") -> None:
     from jax.experimental import multihost_utils
 
     multihost_utils.sync_global_devices(name)
+
+
+# -- native host-coordination helper (native/coord) ---------------------------
+#
+# Replaces what the reference's shell launch protocol did around the NCCL
+# rendezvous: workers polling "is the master up yet" and the launcher's
+# all-hosts-ready barrier (run_distributed_on_platform.sh:6-15, worker.sh:1-5).
+
+def _load_qacoord():
+    import ctypes
+
+    lib_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "native", "build", "libqacoord.so",
+    )
+    if not os.path.exists(lib_path):
+        return None
+    lib = ctypes.CDLL(lib_path)
+    lib.qacoord_wait.restype = ctypes.c_int
+    lib.qacoord_wait.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.qacoord_serve.restype = ctypes.c_int
+    lib.qacoord_serve.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    return lib
+
+
+def wait_for_coordinator(
+    host: str, port: int, *, rank: int = 0, timeout_s: int = 300
+) -> bool:
+    """Block until the coordinator answers this worker's readiness handshake
+    ('w' + 4-byte rank — identity keeps retried/stale connections from being
+    double-counted). Native (C++) when built; pure-Python otherwise."""
+    lib = _load_qacoord()
+    if lib is not None:
+        return (
+            lib.qacoord_wait(host.encode(), int(port), int(timeout_s), int(rank))
+            == 0
+        )
+
+    import socket
+    import struct
+    import time as _time
+
+    deadline = _time.monotonic() + timeout_s
+    while _time.monotonic() < deadline:
+        try:
+            with socket.create_connection((host, port), timeout=2) as s:
+                s.sendall(b"w" + struct.pack("!I", rank))
+                if s.recv(1) == b"g":
+                    return True
+        except OSError:
+            pass
+        _time.sleep(0.25)
+    return False
+
+
+def serve_readiness(port: int, world_size: int, *, timeout_s: int = 300) -> bool:
+    """Coordinator-side barrier: block until world_size-1 DISTINCT worker
+    ranks have checked in. Stray clients / resets are tolerated."""
+    lib = _load_qacoord()
+    if lib is not None:
+        return lib.qacoord_serve(int(port), int(world_size), int(timeout_s)) == 0
+
+    import socket
+    import struct
+
+    with socket.socket() as listener:
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("", port))
+        listener.listen(world_size + 8)
+        listener.settimeout(timeout_s)
+        seen: set = set()
+        while len(seen) < world_size - 1:
+            try:
+                conn, _ = listener.accept()
+            except socket.timeout:
+                return False
+            with conn:
+                try:
+                    conn.settimeout(2)
+                    hello = b""
+                    while len(hello) < 5:
+                        chunk = conn.recv(5 - len(hello))
+                        if not chunk:
+                            break
+                        hello += chunk
+                    if len(hello) == 5 and hello[:1] == b"w":
+                        conn.sendall(b"g")
+                        seen.add(struct.unpack("!I", hello[1:])[0])
+                except OSError:
+                    continue  # reset/stray client — keep serving
+    return True

@@ -92,7 +92,10 @@ class Tokenizer:
         return len(self.tokenizer)
 
     def encode(self, string: str) -> List[int]:
-        if self._native is not None:
+        # ASCII texts (the NQ hot path) take the C++ backend, whose semantics
+        # are exactly the Python spec's on that domain; anything with
+        # multibyte UTF-8 (accents, CJK) uses the full-Unicode Python path.
+        if self._native is not None and string.isascii():
             return self._native.encode(string)
         return self.tokenizer.encode(string)
 

@@ -1,0 +1,109 @@
+"""ctypes bindings for the C++ WordPiece backend (native/qatok).
+
+The shared library is built with ``make -C native`` (g++, no deps). When the
+.so is absent this module reports unavailable and the pure-Python
+implementation serves — behaviour is identical either way: the native path
+only ever receives ASCII text, where its semantics are exactly the Python
+spec's (see native/qatok/wordpiece.cc header).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional
+
+_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native", "build", "libqatok.so",
+)
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        return None
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.qatok_wordpiece_new.restype = ctypes.c_void_p
+    lib.qatok_wordpiece_new.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p]
+    lib.qatok_wordpiece_free.argtypes = [ctypes.c_void_p]
+    lib.qatok_vocab_size.restype = ctypes.c_int32
+    lib.qatok_vocab_size.argtypes = [ctypes.c_void_p]
+    lib.qatok_token_to_id.restype = ctypes.c_int32
+    lib.qatok_token_to_id.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.qatok_wordpiece_encode.restype = ctypes.c_int32
+    lib.qatok_wordpiece_encode.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+    ]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeWordPiece:
+    """Handle on a loaded C++ WordPiece vocab. ASCII text only — callers
+    route non-ASCII to the Python implementation."""
+
+    def __init__(self, vocab_file: str, *, lowercase: bool = True,
+                 handle_chinese_chars: bool = False, unk_token: str = "[UNK]"):
+        # handle_chinese_chars only affects CJK codepoints, which are
+        # non-ASCII and therefore always routed to the Python path — the flag
+        # is accepted for facade symmetry and has no native effect.
+        del handle_chinese_chars
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native qatok library not built (make -C native)")
+        self._lib = lib
+        self._handle = lib.qatok_wordpiece_new(
+            vocab_file.encode(), int(lowercase), unk_token.encode()
+        )
+        if not self._handle:
+            raise RuntimeError(
+                f"qatok could not load vocab {vocab_file!r} (missing file or "
+                f"missing {unk_token!r} entry)"
+            )
+        # per-thread buffers: the loaders encode from a ThreadPoolExecutor and
+        # ctypes releases the GIL during the C call — a shared buffer races
+        import threading
+
+        self._tls = threading.local()
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.qatok_wordpiece_free(handle)
+            self._handle = None
+
+    def __len__(self) -> int:
+        return int(self._lib.qatok_vocab_size(self._handle))
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        i = int(self._lib.qatok_token_to_id(self._handle, token.encode()))
+        return None if i < 0 else i
+
+    def encode(self, text: str) -> List[int]:
+        if not hasattr(self._tls, "buf"):
+            self._tls.cap = 8192
+            self._tls.buf = (ctypes.c_int32 * self._tls.cap)()
+
+        # NUL would terminate the C string; the pipeline drops it anyway
+        # (wordpiece.py:87 cp == 0), so strip before crossing the boundary.
+        raw = text.encode().replace(b"\x00", b"")
+        n = self._lib.qatok_wordpiece_encode(
+            self._handle, raw, self._tls.buf, self._tls.cap
+        )
+        if n < 0:  # grow and retry
+            self._tls.cap = max(-n, self._tls.cap * 2)
+            self._tls.buf = (ctypes.c_int32 * self._tls.cap)()
+            n = self._lib.qatok_wordpiece_encode(
+                self._handle, raw, self._tls.buf, self._tls.cap
+            )
+        return list(self._tls.buf[:n])

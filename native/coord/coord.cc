@@ -1,0 +1,160 @@
+// Host coordination helper — the native replacement for the reference's
+// shell-level master-IP-scrape + NCCL TCP-store rendezvous protocol
+// (scripts/run_distributed_on_platform.sh:6-15, worker.sh:1-5; SURVEY.md
+// §3.4). jax.distributed.initialize owns the actual collective bootstrap;
+// this helper owns what the shell scripts did around it: workers blocking
+// until the coordinator host is reachable (replacing brittle sleep loops)
+// and a world-size barrier so the launcher knows every host came up.
+//
+// Built as both a shared lib (ctypes, ml_recipe_tpu/parallel/dist.py) and a
+// tiny CLI (`qacoord serve <port> <world_size>` / `qacoord wait <host> <port>
+// [timeout_s]`) for launch scripts.
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+int connect_once(const char* host, int port) {
+  struct addrinfo hints, *res = nullptr;
+  std::memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  char portbuf[16];
+  std::snprintf(portbuf, sizeof(portbuf), "%d", port);
+  if (getaddrinfo(host, portbuf, &hints, &res) != 0) return -1;
+  int fd = -1;
+  for (auto* p = res; p; p = p->ai_next) {
+    fd = socket(p->ai_family, p->ai_socktype, p->ai_protocol);
+    if (fd < 0) continue;
+    struct timeval tv {2, 0};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    if (connect(fd, p->ai_addr, p->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  return fd;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Block until `host:port` accepts and acknowledges this worker's hello
+// ('w' + 4-byte network-order rank — identity prevents a retried/stale
+// connection from being double-counted). Returns 0 on success, -1 on
+// timeout. Replaces worker-side "is the master up yet" polling.
+int qacoord_wait(const char* host, int port, int timeout_s, int rank) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::seconds(timeout_s > 0 ? timeout_s : 300);
+  while (std::chrono::steady_clock::now() < deadline) {
+    int fd = connect_once(host, port);
+    if (fd >= 0) {
+      char hello[5];
+      hello[0] = 'w';
+      uint32_t r_be = htonl((uint32_t)rank);
+      std::memcpy(hello + 1, &r_be, 4);
+      (void)!write(fd, hello, 5);
+      char r = 0;
+      ssize_t n = read(fd, &r, 1);
+      close(fd);
+      if (n == 1 && r == 'g') return 0;  // server said go
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(250));
+  }
+  return -1;
+}
+
+// Serve the readiness barrier: accept hellos until `world_size - 1` DISTINCT
+// worker ranks have checked in, answering each with 'g'. Returns 0 when all
+// peers checked in, -1 on timeout/socket error. The coordinator host runs
+// this before (or concurrently with) jax.distributed.initialize.
+int qacoord_serve(int port, int world_size, int timeout_s) {
+  int listener = socket(AF_INET, SOCK_STREAM, 0);
+  if (listener < 0) return -1;
+  int one = 1;
+  setsockopt(listener, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+
+  struct sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(listener, (struct sockaddr*)&addr, sizeof(addr)) < 0 ||
+      listen(listener, world_size + 8) < 0) {
+    close(listener);
+    return -1;
+  }
+
+  struct timeval tv {timeout_s > 0 ? timeout_s : 300, 0};
+  setsockopt(listener, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+
+  std::set<uint32_t> seen;
+  while ((int)seen.size() < world_size - 1) {
+    int fd = accept(listener, nullptr, nullptr);
+    if (fd < 0) {
+      close(listener);
+      return -1;  // timeout / error
+    }
+    struct timeval ctv {2, 0};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &ctv, sizeof(ctv));
+    char hello[5];
+    ssize_t got = 0;
+    while (got < 5) {  // stray clients / RSTs just drop out of the loop
+      ssize_t n = read(fd, hello + got, 5 - got);
+      if (n <= 0) break;
+      got += n;
+    }
+    if (got == 5 && hello[0] == 'w') {
+      uint32_t r_be;
+      std::memcpy(&r_be, hello + 1, 4);
+      char g = 'g';
+      (void)!write(fd, &g, 1);
+      seen.insert(ntohl(r_be));
+    }
+    close(fd);
+  }
+  close(listener);
+  return 0;
+}
+
+}  // extern "C"
+
+#ifdef QACOORD_MAIN
+int main(int argc, char** argv) {
+  if (argc >= 4 && std::string(argv[1]) == "serve") {
+    int timeout = argc > 4 ? std::atoi(argv[4]) : 300;
+    int rc = qacoord_serve(std::atoi(argv[2]), std::atoi(argv[3]), timeout);
+    std::fprintf(stderr, rc == 0 ? "qacoord: all peers ready\n"
+                                 : "qacoord: serve failed/timeout\n");
+    return rc == 0 ? 0 : 1;
+  }
+  if (argc >= 4 && std::string(argv[1]) == "wait") {
+    int timeout = argc > 4 ? std::atoi(argv[4]) : 300;
+    int rank = argc > 5 ? std::atoi(argv[5]) : 0;
+    int rc = qacoord_wait(argv[2], std::atoi(argv[3]), timeout, rank);
+    std::fprintf(stderr, rc == 0 ? "qacoord: coordinator ready\n"
+                                 : "qacoord: wait timeout\n");
+    return rc == 0 ? 0 : 1;
+  }
+  std::fprintf(stderr,
+               "usage: qacoord serve <port> <world_size> [timeout_s]\n"
+               "       qacoord wait <host> <port> [timeout_s] [rank]\n");
+  return 2;
+}
+#endif

@@ -1,0 +1,171 @@
+// First-party C++ WordPiece tokenizer — the native hot path replacing the
+// Rust `tokenizers.BertWordPieceTokenizer` dependency the reference wraps in
+// modules/model/model/tokenizer.py:26-31 (SURVEY.md §2.2: Rust/C++ deps the
+// TPU build must own).
+//
+// Scope: EXACT parity with the Python spec implementation
+// (ml_recipe_tpu/tokenizer/wordpiece.py) on ASCII text — where BERT basic
+// tokenization (clean/lower/punct-split) is fully defined by ASCII rules and
+// NFD accent-stripping is the identity. The Python facade routes ASCII texts
+// here and anything containing multibyte UTF-8 to the Python path, so
+// behaviour never diverges; English corpora (the reference's NQ task) are
+// overwhelmingly ASCII.
+//
+// C ABI (ctypes-friendly): no exceptions across the boundary, plain int
+// returns, caller-owned buffers.
+
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct WordPiece {
+  std::unordered_map<std::string, int32_t> vocab;
+  bool lowercase = true;
+  std::string unk_token = "[UNK]";
+  int32_t unk_id = -1;
+  int max_input_chars_per_word = 100;
+};
+
+inline bool is_ascii_ws(unsigned char c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r';
+}
+
+inline bool is_ascii_control(unsigned char c) {
+  // ASCII Cc minus \t\n\r (wordpiece.py:29-32 on the ASCII domain)
+  if (c == '\t' || c == '\n' || c == '\r') return false;
+  return c < 0x20 || c == 0x7F;
+}
+
+inline bool is_ascii_punct(unsigned char c) {
+  // wordpiece.py:41-45 ASCII ranges
+  return (c >= 33 && c <= 47) || (c >= 58 && c <= 64) ||
+         (c >= 91 && c <= 96) || (c >= 123 && c <= 126);
+}
+
+// Greedy longest-match WordPiece (wordpiece.py:133-155).
+void wordpiece_word(const WordPiece& wp, const std::string& word,
+                    std::vector<int32_t>* out) {
+  if ((int)word.size() > wp.max_input_chars_per_word) {
+    out->push_back(wp.unk_id);
+    return;
+  }
+  std::vector<int32_t> pieces;
+  size_t start = 0;
+  const size_t n = word.size();
+  std::string piece;
+  while (start < n) {
+    size_t end = n;
+    int32_t cur = -1;
+    while (start < end) {
+      piece.assign(start > 0 ? "##" : "");
+      piece.append(word, start, end - start);
+      auto it = wp.vocab.find(piece);
+      if (it != wp.vocab.end()) {
+        cur = it->second;
+        break;
+      }
+      --end;
+    }
+    if (cur < 0) {
+      out->push_back(wp.unk_id);
+      return;
+    }
+    pieces.push_back(cur);
+    start = end;
+  }
+  out->insert(out->end(), pieces.begin(), pieces.end());
+}
+
+// Full pipeline for one ASCII text: clean -> split ws -> lower ->
+// punct-split -> wordpiece (wordpiece.py:83-168, ASCII domain).
+void encode_ascii(const WordPiece& wp, const char* text,
+                  std::vector<int32_t>* out) {
+  std::string word;
+  const auto flush_word = [&]() {
+    if (word.empty()) return;
+    wordpiece_word(wp, word, out);
+    word.clear();
+  };
+
+  for (const char* p = text; *p; ++p) {
+    unsigned char c = (unsigned char)*p;
+    if (c == 0 || is_ascii_control(c)) continue;  // _clean_text drop
+    if (is_ascii_ws(c)) {
+      flush_word();
+      continue;
+    }
+    if (is_ascii_punct(c)) {  // punctuation is its own token
+      flush_word();
+      word.push_back((char)c);
+      flush_word();
+      continue;
+    }
+    word.push_back(wp.lowercase ? (char)std::tolower(c) : (char)c);
+  }
+  flush_word();
+}
+
+}  // namespace
+
+extern "C" {
+
+void* qatok_wordpiece_new(const char* vocab_path, int lowercase,
+                          const char* unk_token) {
+  std::ifstream in(vocab_path);
+  if (!in.good()) return nullptr;
+  auto* wp = new WordPiece();
+  wp->lowercase = lowercase != 0;
+  if (unk_token && *unk_token) wp->unk_token = unk_token;
+
+  std::string line;
+  int32_t i = 0;
+  while (std::getline(in, line)) {
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    if (!line.empty()) wp->vocab.emplace(line, i);
+    ++i;
+  }
+  auto it = wp->vocab.find(wp->unk_token);
+  if (it == wp->vocab.end()) {
+    delete wp;
+    return nullptr;  // vocab without UNK is unusable
+  }
+  wp->unk_id = it->second;
+  return wp;
+}
+
+void qatok_wordpiece_free(void* handle) {
+  delete static_cast<WordPiece*>(handle);
+}
+
+int32_t qatok_vocab_size(void* handle) {
+  auto* wp = static_cast<WordPiece*>(handle);
+  int32_t mx = -1;
+  for (const auto& kv : wp->vocab)
+    if (kv.second > mx) mx = kv.second;
+  return mx + 1;
+}
+
+int32_t qatok_token_to_id(void* handle, const char* token) {
+  auto* wp = static_cast<WordPiece*>(handle);
+  auto it = wp->vocab.find(token);
+  return it == wp->vocab.end() ? -1 : it->second;
+}
+
+// Encode `text` (must be ASCII; caller pre-checks) into `out` (capacity
+// `cap`). Returns the id count, or -(needed) when cap is too small.
+int32_t qatok_wordpiece_encode(void* handle, const char* text, int32_t* out,
+                               int32_t cap) {
+  auto* wp = static_cast<WordPiece*>(handle);
+  std::vector<int32_t> ids;
+  encode_ascii(*wp, text, &ids);
+  if ((int32_t)ids.size() > cap) return -(int32_t)ids.size();
+  std::memcpy(out, ids.data(), ids.size() * sizeof(int32_t));
+  return (int32_t)ids.size();
+}
+
+}  // extern "C"

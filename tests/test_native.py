@@ -1,0 +1,193 @@
+"""Native C++ backend tests: WordPiece parity vs the Python spec, the
+coordination helper's barrier protocol, and facade routing."""
+
+import random
+import string
+import subprocess
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+from helpers import BASE_VOCAB, WORDS, write_vocab
+
+
+@pytest.fixture(scope="session", autouse=True)
+def build_native():
+    """Build the native libs once per session (g++, ~1s). Tests that need
+    them skip if the toolchain is unavailable."""
+    try:
+        subprocess.run(
+            ["make", "-C", str(REPO / "native")], check=True,
+            capture_output=True, timeout=120,
+        )
+    except Exception:
+        pass
+
+
+def _native_available():
+    from ml_recipe_tpu.tokenizer import native
+
+    return native.available()
+
+
+def _random_ascii_text(rng, n_words=30):
+    pieces = []
+    for _ in range(n_words):
+        choice = rng.random()
+        if choice < 0.5:
+            pieces.append(rng.choice(WORDS).replace("##", ""))
+        elif choice < 0.7:
+            pieces.append("".join(rng.choices(string.ascii_letters, k=rng.randint(1, 12))))
+        elif choice < 0.85:
+            pieces.append(rng.choice([".", ",", "?", "!", "(", ")", '"', "don't", "u.s."]))
+        else:
+            pieces.append(str(rng.randint(0, 99999)))
+        if rng.random() < 0.2:
+            pieces.append(rng.choice(["\t", "  ", "\n"]))
+    return " ".join(pieces)
+
+
+def test_wordpiece_native_matches_python(tmp_path):
+    if not _native_available():
+        pytest.skip("native qatok not built")
+    from ml_recipe_tpu.tokenizer.native import NativeWordPiece
+    from ml_recipe_tpu.tokenizer.wordpiece import WordPieceTokenizer
+
+    vocab = write_vocab(tmp_path)
+    py = WordPieceTokenizer(str(vocab), lowercase=True)
+    cc = NativeWordPiece(str(vocab), lowercase=True)
+
+    assert len(py) == len(cc)
+
+    rng = random.Random(0)
+    for trial in range(200):
+        text = _random_ascii_text(rng)
+        assert cc.encode(text) == py.encode(text), f"trial {trial}: {text!r}"
+
+
+def test_wordpiece_native_edge_cases(tmp_path):
+    if not _native_available():
+        pytest.skip("native qatok not built")
+    from ml_recipe_tpu.tokenizer.native import NativeWordPiece
+    from ml_recipe_tpu.tokenizer.wordpiece import WordPieceTokenizer
+
+    vocab = write_vocab(tmp_path)
+    py = WordPieceTokenizer(str(vocab), lowercase=True)
+    cc = NativeWordPiece(str(vocab), lowercase=True)
+
+    cases = [
+        "",
+        " ",
+        "\t\n\r",
+        "...",
+        "a" * 150,               # exceeds max_input_chars_per_word -> UNK
+        "THE QUICK BROWN FOX",   # lowercase path
+        "un##known",             # '#' is punctuation at text level
+        "the.quick,brown?fox",
+        "\x00\x01control\x7fchars",
+    ]
+    for text in cases:
+        assert cc.encode(text) == py.encode(text), repr(text)
+
+
+def test_facade_uses_native_for_ascii_and_python_for_unicode(tmp_path):
+    if not _native_available():
+        pytest.skip("native qatok not built")
+    from ml_recipe_tpu.tokenizer import Tokenizer
+
+    vocab = write_vocab(tmp_path)
+    tok = Tokenizer("bert", str(vocab), lowercase=True)
+    assert tok._native is not None
+
+    # ASCII: native path; result equals the pure-Python tokenizer's
+    ascii_ids = tok.encode("the quick brown fox")
+    assert ascii_ids == tok.tokenizer.encode("the quick brown fox")
+
+    # non-ASCII (accented) routes to Python and strips the accent via NFD
+    assert tok.encode("thé") == tok.tokenizer.encode("thé")
+
+
+def test_qacoord_barrier():
+    qacoord = REPO / "native" / "build" / "qacoord"
+    if not qacoord.exists():
+        pytest.skip("qacoord not built")
+
+    port = 29765
+    server = subprocess.Popen(
+        [str(qacoord), "serve", str(port), "3", "30"],
+        stderr=subprocess.PIPE,
+    )
+    time.sleep(0.3)
+
+    rcs = []
+
+    def worker(rank):
+        rc = subprocess.run(
+            [str(qacoord), "wait", "127.0.0.1", str(port), "30", str(rank)],
+            capture_output=True, timeout=35,
+        ).returncode
+        rcs.append(rc)
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in (1, 2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=35)
+
+    assert server.wait(timeout=35) == 0
+    assert rcs == [0, 0]
+
+
+def test_qacoord_dedupes_worker_ranks():
+    """The same rank checking in twice must NOT release the barrier early."""
+    qacoord = REPO / "native" / "build" / "qacoord"
+    if not qacoord.exists():
+        pytest.skip("qacoord not built")
+
+    port = 29767
+    server = subprocess.Popen([str(qacoord), "serve", str(port), "3", "4"])
+    time.sleep(0.3)
+    # rank 1 connects twice; rank 2 never arrives -> serve must time out
+    for _ in range(2):
+        subprocess.run(
+            [str(qacoord), "wait", "127.0.0.1", str(port), "3", "1"],
+            capture_output=True, timeout=10,
+        )
+    assert server.wait(timeout=10) == 1  # timeout, barrier NOT released
+
+
+def test_native_tokenizer_thread_safety(tmp_path):
+    if not _native_available():
+        pytest.skip("native qatok not built")
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ml_recipe_tpu.tokenizer.native import NativeWordPiece
+    from ml_recipe_tpu.tokenizer.wordpiece import WordPieceTokenizer
+
+    vocab = write_vocab(tmp_path)
+    py = WordPieceTokenizer(str(vocab), lowercase=True)
+    cc = NativeWordPiece(str(vocab), lowercase=True)
+
+    rng = random.Random(1)
+    texts = [_random_ascii_text(rng, n_words=60) for _ in range(300)]
+    expected = [py.encode(t) for t in texts]
+
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        got = list(pool.map(cc.encode, texts))
+
+    assert got == expected
+
+
+def test_qacoord_wait_timeout():
+    qacoord = REPO / "native" / "build" / "qacoord"
+    if not qacoord.exists():
+        pytest.skip("qacoord not built")
+    rc = subprocess.run(
+        [str(qacoord), "wait", "127.0.0.1", "29766", "1"],
+        capture_output=True, timeout=20,
+    ).returncode
+    assert rc == 1
