@@ -116,22 +116,28 @@ def barrier(name: str = "barrier") -> None:
 # rendezvous: workers polling "is the master up yet" and the launcher's
 # all-hosts-ready barrier (run_distributed_on_platform.sh:6-15, worker.sh:1-5).
 
+_qacoord = None
+
+
 def _load_qacoord():
+    global _qacoord
+    if _qacoord is not None:
+        return _qacoord
+
     import ctypes
 
-    lib_path = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-        "native", "build", "libqacoord.so",
-    )
-    if not os.path.exists(lib_path):
+    from ml_recipe_tpu.utils.nativelib import load_native_lib
+
+    lib = load_native_lib("libqacoord.so")
+    if lib is None:
         return None
-    lib = ctypes.CDLL(lib_path)
     lib.qacoord_wait.restype = ctypes.c_int
     lib.qacoord_wait.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
     ]
     lib.qacoord_serve.restype = ctypes.c_int
     lib.qacoord_serve.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    _qacoord = lib
     return lib
 
 
@@ -174,21 +180,33 @@ def serve_readiness(port: int, world_size: int, *, timeout_s: int = 300) -> bool
 
     import socket
     import struct
+    import time as _time
 
+    # Global deadline: settimeout bounds each accept() individually, so
+    # re-arm with the remaining time each iteration — stray clients must not
+    # keep the barrier alive past timeout_s.
+    deadline = _time.monotonic() + timeout_s
     with socket.socket() as listener:
         listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         listener.bind(("", port))
         listener.listen(world_size + 8)
-        listener.settimeout(timeout_s)
         seen: set = set()
         while len(seen) < world_size - 1:
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                return False
+            listener.settimeout(remaining)
             try:
                 conn, _ = listener.accept()
             except socket.timeout:
                 return False
             with conn:
                 try:
-                    conn.settimeout(2)
+                    # clamp to the remaining deadline: a byte-dripping client
+                    # must not stretch the barrier past timeout_s
+                    conn.settimeout(
+                        max(min(2.0, deadline - _time.monotonic()), 0.001)
+                    )
                     hello = b""
                     while len(hello) < 5:
                         chunk = conn.recv(5 - len(hello))

@@ -10,13 +10,7 @@ spec's (see native/qatok/wordpiece.cc header).
 from __future__ import annotations
 
 import ctypes
-import os
 from typing import List, Optional
-
-_LIB_PATH = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "native", "build", "libqatok.so",
-)
 
 _lib = None
 
@@ -25,9 +19,11 @@ def _load():
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_LIB_PATH):
+    from ml_recipe_tpu.utils.nativelib import load_native_lib
+
+    lib = load_native_lib("libqatok.so")
+    if lib is None:
         return None
-    lib = ctypes.CDLL(_LIB_PATH)
     lib.qatok_wordpiece_new.restype = ctypes.c_void_p
     lib.qatok_wordpiece_new.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p]
     lib.qatok_wordpiece_free.argtypes = [ctypes.c_void_p]

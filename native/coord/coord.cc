@@ -101,17 +101,37 @@ int qacoord_serve(int port, int world_size, int timeout_s) {
     return -1;
   }
 
-  struct timeval tv {timeout_s > 0 ? timeout_s : 300, 0};
-  setsockopt(listener, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  // Global deadline: SO_RCVTIMEO bounds each accept() individually and
+  // resets on every connection, so re-arm it with the REMAINING time each
+  // iteration — otherwise stray clients (health checks, port scans) could
+  // keep the barrier alive past timeout_s forever.
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::seconds(timeout_s > 0 ? timeout_s : 300);
 
   std::set<uint32_t> seen;
   while ((int)seen.size() < world_size - 1) {
+    auto remaining_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                            deadline - std::chrono::steady_clock::now())
+                            .count();
+    if (remaining_ms <= 0) {
+      close(listener);
+      return -1;  // deadline passed while serving stray connections
+    }
+    struct timeval tv {remaining_ms / 1000, (remaining_ms % 1000) * 1000};
+    setsockopt(listener, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     int fd = accept(listener, nullptr, nullptr);
     if (fd < 0) {
       close(listener);
       return -1;  // timeout / error
     }
-    struct timeval ctv {2, 0};
+    // clamp the per-connection read budget to the remaining deadline so a
+    // byte-dripping client can't stretch the barrier past timeout_s
+    remaining_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                       deadline - std::chrono::steady_clock::now())
+                       .count();
+    long conn_ms = remaining_ms < 2000 ? (remaining_ms > 1 ? remaining_ms : 1)
+                                       : 2000;
+    struct timeval ctv {conn_ms / 1000, (conn_ms % 1000) * 1000};
     setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &ctv, sizeof(ctv));
     char hello[5];
     ssize_t got = 0;

@@ -122,12 +122,21 @@ void* qatok_wordpiece_new(const char* vocab_path, int lowercase,
   wp->lowercase = lowercase != 0;
   if (unk_token && *unk_token) wp->unk_token = unk_token;
 
-  std::string line;
+  // Parity with the Python spec's load_vocab (wordpiece.py:19-26), which
+  // reads in text mode: universal newlines (\n, \r\n, and lone \r all split
+  // and are stripped), duplicates overwrite (last id wins).
+  std::string data((std::istreambuf_iterator<char>(in)),
+                   std::istreambuf_iterator<char>());
+  size_t pos = 0;
   int32_t i = 0;
-  while (std::getline(in, line)) {
-    if (!line.empty() && line.back() == '\r') line.pop_back();
-    if (!line.empty()) wp->vocab.emplace(line, i);
+  while (pos <= data.size()) {
+    size_t e = data.find_first_of("\r\n", pos);
+    size_t end = (e == std::string::npos) ? data.size() : e;
+    if (end > pos) wp->vocab[data.substr(pos, end - pos)] = i;
     ++i;
+    if (e == std::string::npos) break;
+    pos = e + 1;
+    if (data[e] == '\r' && pos < data.size() && data[pos] == '\n') ++pos;
   }
   auto it = wp->vocab.find(wp->unk_token);
   if (it == wp->vocab.end()) {
@@ -143,11 +152,11 @@ void qatok_wordpiece_free(void* handle) {
 }
 
 int32_t qatok_vocab_size(void* handle) {
+  // len(vocab) parity with the Python spec (wordpiece.py:78-79): distinct
+  // token count, not max-id+1 — they differ on files with blank/duplicate
+  // lines.
   auto* wp = static_cast<WordPiece*>(handle);
-  int32_t mx = -1;
-  for (const auto& kv : wp->vocab)
-    if (kv.second > mx) mx = kv.second;
-  return mx + 1;
+  return (int32_t)wp->vocab.size();
 }
 
 int32_t qatok_token_to_id(void* handle, const char* token) {

@@ -34,6 +34,14 @@ def _native_available():
     return native.available()
 
 
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
 def _random_ascii_text(rng, n_words=30):
     pieces = []
     for _ in range(n_words):
@@ -116,7 +124,7 @@ def test_qacoord_barrier():
     if not qacoord.exists():
         pytest.skip("qacoord not built")
 
-    port = 29765
+    port = _free_port()
     server = subprocess.Popen(
         [str(qacoord), "serve", str(port), "3", "30"],
         stderr=subprocess.PIPE,
@@ -148,7 +156,7 @@ def test_qacoord_dedupes_worker_ranks():
     if not qacoord.exists():
         pytest.skip("qacoord not built")
 
-    port = 29767
+    port = _free_port()
     server = subprocess.Popen([str(qacoord), "serve", str(port), "3", "4"])
     time.sleep(0.3)
     # rank 1 connects twice; rank 2 never arrives -> serve must time out
@@ -187,7 +195,87 @@ def test_qacoord_wait_timeout():
     if not qacoord.exists():
         pytest.skip("qacoord not built")
     rc = subprocess.run(
-        [str(qacoord), "wait", "127.0.0.1", "29766", "1"],
+        [str(qacoord), "wait", "127.0.0.1", str(_free_port()), "1"],
         capture_output=True, timeout=20,
     ).returncode
     assert rc == 1
+
+
+def test_qacoord_serve_deadline_is_global():
+    """Stray clients reconnecting must not extend the barrier past timeout_s
+    (each accept used to re-arm the socket timeout indefinitely)."""
+    import socket
+
+    qacoord = REPO / "native" / "build" / "qacoord"
+    if not qacoord.exists():
+        pytest.skip("qacoord not built")
+
+    port = _free_port()
+    server = subprocess.Popen([str(qacoord), "serve", str(port), "2", "2"])
+    t0 = time.monotonic()
+    # hammer with hello-less connections (health-check style) past the deadline
+    while server.poll() is None and time.monotonic() - t0 < 10:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                time.sleep(0.1)
+        except OSError:
+            time.sleep(0.1)
+    assert server.wait(timeout=10) == 1  # timed out despite constant traffic
+    assert time.monotonic() - t0 < 8
+
+
+def test_python_serve_deadline_is_global():
+    import socket
+
+    from ml_recipe_tpu.parallel import dist
+
+    port = _free_port()
+    result = {}
+
+    def serve():
+        # force the pure-Python fallback regardless of the built .so
+        lib, dist._qacoord = dist._qacoord, None
+        orig = dist._load_qacoord
+        dist._load_qacoord = lambda: None
+        try:
+            result["ok"] = dist.serve_readiness(port, 2, timeout_s=2)
+        finally:
+            dist._load_qacoord = orig
+            dist._qacoord = lib
+
+    th = threading.Thread(target=serve)
+    t0 = time.monotonic()
+    th.start()
+    while th.is_alive() and time.monotonic() - t0 < 10:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                time.sleep(0.1)
+        except OSError:
+            time.sleep(0.1)
+    th.join(timeout=10)
+    assert result.get("ok") is False
+    assert time.monotonic() - t0 < 8
+
+
+def test_wordpiece_native_vocab_parity_crlf_and_duplicates(tmp_path):
+    """Vocab-file edge cases must match the Python spec, which reads in text
+    mode: universal newlines (\\n, \\r\\n, lone \\r all split and are
+    stripped), blank lines skipped but still numbered, duplicate tokens ->
+    last id wins."""
+    if not _native_available():
+        pytest.skip("native qatok not built")
+    from ml_recipe_tpu.tokenizer.native import NativeWordPiece
+    from ml_recipe_tpu.tokenizer.wordpiece import WordPieceTokenizer
+
+    vocab = tmp_path / "crlf_vocab.txt"
+    vocab.write_bytes(b"[UNK]\r\nthe\r\nthe\r\nquick\r\n\r\nfox\rcr_only\rlast")
+
+    py = WordPieceTokenizer(str(vocab), lowercase=True)
+    cc = NativeWordPiece(str(vocab), lowercase=True)
+
+    assert py.vocab == {
+        "[UNK]": 0, "the": 2, "quick": 3, "fox": 5, "cr_only": 6, "last": 7,
+    }
+    assert len(py) == len(cc)
+    for tok in ["the", "quick", "fox", "cr_only", "last", "the\r", "missing"]:
+        assert cc.token_to_id(tok) == py.vocab.get(tok), repr(tok)
