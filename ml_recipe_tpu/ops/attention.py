@@ -94,34 +94,38 @@ def dot_product_attention(
         )
 
     if impl == "auto":
-        from .flash_attention import _pick_q_block
+        from .flash_attention import _pick_q_block, supports_fused_bwd
 
-        use_pallas = (
-            jax.default_backend() == "tpu"
-            and dropout_rate == 0.0
-            and _pick_q_block(q.shape[1]) is not None
+        L = q.shape[1]
+        use_pallas = jax.default_backend() == "tpu" and (
+            # dropout lives inside the fully-fused kernel only
+            supports_fused_bwd(L)
+            if dropout_rate > 0.0
+            else _pick_q_block(L) is not None
         )
         impl = "pallas" if use_pallas else "xla"
 
     if impl == "pallas":
-        if dropout_rate > 0.0:
+        from .flash_attention import flash_attention, supports_fused_bwd
+
+        if dropout_rate > 0.0 and not supports_fused_bwd(q.shape[1]):
             import logging
 
             logging.getLogger(__name__).warning(
-                "Pallas flash-attention has no dropout path; using XLA "
-                "attention so attention-dropout regularization is preserved."
+                "Pallas fused attention supports dropout only at L <= 512; "
+                "using XLA attention so attention-dropout is preserved."
             )
         else:
-            try:
-                from .flash_attention import flash_attention
-            except ImportError:  # kernel unavailable on this build — fall back
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "Pallas flash-attention kernel unavailable; falling back to XLA."
+            seed = None
+            if dropout_rate > 0.0:
+                assert dropout_rng is not None, "dropout_rate > 0 needs dropout_rng"
+                seed = jax.random.randint(
+                    dropout_rng, (1,), minval=jnp.iinfo(jnp.int32).min,
+                    maxval=jnp.iinfo(jnp.int32).max, dtype=jnp.int32,
                 )
-            else:
-                return flash_attention(q, k, v, mask, dtype=dtype)
+            return flash_attention(
+                q, k, v, mask, seed=seed, dtype=dtype, rate=dropout_rate
+            )
 
     return _xla_attention(
         q, k, v, mask, dropout_rate=dropout_rate, dropout_rng=dropout_rng, dtype=dtype

@@ -1,21 +1,32 @@
-"""Fused attention Pallas TPU kernel.
+"""Fused attention Pallas TPU kernels (forward AND backward, with dropout).
 
 Replaces the HF/CUDA attention internals of the reference's BertModel trunk
-(SURVEY.md §2.2) with a first-party kernel. For BERT-class sequence lengths
-(<= 2k) the whole K/V for one (batch, head) fits in VMEM, so the kernel is an
-*exact* fused softmax-attention: scores for one query block are computed,
-softmaxed and contracted against V entirely on-chip — the [B, H, L, L] score
-tensor never exists in HBM (that tensor is the HBM-bandwidth bottleneck of
-the naive path).
+(SURVEY.md §2.2) with first-party kernels. For BERT-class sequence lengths
+(<= 2k) the whole K/V for one (batch, head) fits in VMEM, so the kernels are
+*exact* fused softmax-attention: the [B, H, L, L] score tensor never exists in
+HBM (that tensor is the HBM-bandwidth bottleneck of the naive path, in both
+the forward and the backward).
 
 Layout: q/k/v arrive as [B, L, H, D] (the encoder's natural layout — no
-transposes inserted). Grid is (B, H, L/q_blk); each program computes one
-query block against the full keys.
+transposes inserted; XLA fuses the [B,H,L,D] relayout into the projection
+matmuls).
 
-Backward: the kernel carries a ``jax.custom_vjp`` whose backward pass
-recomputes attention with the XLA einsum path and differentiates that —
-forward (the inference/serving hot path and 1/3 of training FLOPs) runs the
-kernel, gradients stay exact.
+Three regimes:
+- ``L <= _FUSED_BWD_MAX_LEN``: fully fused — one program per (batch, head)
+  computes the whole head in VMEM, forward and backward, with optional
+  attention-probs dropout applied INSIDE the kernel. This covers the
+  reference's training shape (max_seq_len <= 512, config/test_bert.cfg:66).
+- larger L, no dropout: q-blocked forward kernel + XLA-recompute backward
+  (exact, but scores materialize in HBM during the backward).
+- anything else: the dispatcher (ops/attention.py) uses the XLA path.
+
+Dropout determinism: the backward must regenerate the exact forward mask. The
+kernels derive keep-bits from a murmur3-finalizer hash of
+(seed, batch*heads+head, row*L+col) in plain int32 vector ops — bit-exact
+between forward/backward, across devices, and in pallas interpret mode on CPU
+(no reliance on the TPU hardware PRNG, whose primitives have no interpret
+rules). The reference's dropout semantics (torch: inverted scaling by
+1/(1-p)) are preserved in distribution.
 """
 
 from __future__ import annotations
@@ -30,38 +41,125 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
+# Fully-fused fwd+bwd VMEM budget: ~5 f32 [L, L] temporaries (scores, probs,
+# keep, dprobs, dscores) + the [L, D] operands. 512 -> ~6 MB, well under the
+# ~16 MB/core VMEM; 1024 would need ~21 MB.
+_FUSED_BWD_MAX_LEN = 512
 
-def _attention_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, *, scale: float):
-    """One (batch, head, q-block) program: softmax(q k^T) v, fully in VMEM.
 
-    Block shapes (leading singleton dims indexed away by the grid; inputs are
-    pre-transposed to [B, H, L, D] so the trailing block dims [q_blk/L, D]
-    satisfy the TPU (8, 128)-or-equal tiling rule):
-      q_ref: [1, 1, q_blk, D]; k_ref/v_ref: [1, 1, L, D]; mask_ref: [1, 1, L]
-      o_ref: [1, 1, q_blk, D]
-    """
-    q = q_ref[0, 0, :, :]  # [q_blk, D]
-    k = k_ref[0, 0, :, :]  # [L, D]
-    v = v_ref[0, 0, :, :]  # [L, D]
+def _uniform_grid(seed, bh, L: int):
+    """[L, L] uniform floats in [0, 1) from a murmur3-finalizer hash of
+    (seed, batch*heads+head, flat index). Plain int32 vector ops only."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (L, L), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (L, L), 1)
+    x = rows * jnp.int32(L) + cols
+    x = x ^ (seed + bh * jnp.int32(-1640531527))  # 2654435761 as int32
+    x = x * jnp.int32(-862048943)   # 0xCC9E2D51
+    x = x ^ ((x >> 16) & jnp.int32(0xFFFF))
+    x = x * jnp.int32(0x1B873593)
+    x = x ^ ((x >> 13) & jnp.int32(0x7FFFF))
+    x = x * jnp.int32(-1028477387)  # 0xC2B2AE35
+    u24 = (x >> 7) & jnp.int32(0x00FFFFFF)  # 24 uniform bits -> [0, 1)
+    return u24.astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
 
+
+def _softmax_probs(q, k, mask, scale):
+    """[L, L] f32 attention probabilities for one (batch, head)."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )  # [q_blk, L] in f32 on the MXU
+    )
     s = s * scale
-
-    mask = mask_ref[0, 0, :]  # [L]
     s = jnp.where(mask[None, :] > 0, s, _NEG_INF)
-
-    # numerically-stable softmax in f32 on the VPU
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
-    denom = jnp.sum(p, axis=-1, keepdims=True)
-    p = p / denom
+    return p / jnp.sum(p, axis=-1, keepdims=True)
+
+
+def _fused_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, o_ref,
+                      *, scale: float, rate: float, heads: int):
+    """One (batch, head) program: softmax(q k^T / sqrt(d)) v with optional
+    attention-probs dropout, fully in VMEM."""
+    q = q_ref[0, 0, :, :]
+    k = k_ref[0, 0, :, :]
+    v = v_ref[0, 0, :, :]
+    mask = mask_ref[0, 0, :]
+
+    p = _softmax_probs(q, k, mask, scale)
+
+    if rate > 0.0:
+        b, h = pl.program_id(0), pl.program_id(1)
+        u = _uniform_grid(seed_ref[0], b * heads + h, q.shape[0])
+        p = jnp.where(u >= rate, p * (1.0 / (1.0 - rate)), 0.0)
 
     o = jax.lax.dot_general(
         p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-    )  # [q_blk, D]
+    )
+    o_ref[0, 0, :, :] = o.astype(o_ref.dtype)
+
+
+def _fused_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
+                      dq_ref, dk_ref, dv_ref,
+                      *, scale: float, rate: float, heads: int):
+    """One (batch, head) program: exact attention backward, recomputing the
+    probabilities (and regenerating the identical dropout mask) in VMEM."""
+    q = q_ref[0, 0, :, :]
+    k = k_ref[0, 0, :, :]
+    v = v_ref[0, 0, :, :]
+    g = g_ref[0, 0, :, :]
+    mask = mask_ref[0, 0, :]
+
+    p = _softmax_probs(q, k, mask, scale)  # [L, L] f32, pre-dropout
+
+    if rate > 0.0:
+        b, h = pl.program_id(0), pl.program_id(1)
+        keep = _uniform_grid(seed_ref[0], b * heads + h, q.shape[0]) >= rate
+        inv = jnp.float32(1.0 / (1.0 - rate))
+        p_drop = jnp.where(keep, p * inv, 0.0)
+    else:
+        p_drop = p
+
+    # dv = p_drop^T g
+    dv = jax.lax.dot_general(
+        p_drop.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    # dp_drop = g v^T
+    dp_drop = jax.lax.dot_general(
+        g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    # dropout backward, then softmax backward
+    if rate > 0.0:
+        dp = jnp.where(keep, dp_drop * inv, 0.0)
+    else:
+        dp = dp_drop
+    row = jnp.sum(dp * p, axis=-1, keepdims=True)
+    ds = p * (dp - row)  # [L, L] f32; zero on masked keys since p is zero
+
+    dq = jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    dk = jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
+    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+
+
+def _blocked_fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, *, scale: float):
+    """One (batch, head, q-block) program for longer sequences (no dropout)."""
+    q = q_ref[0, 0, :, :]
+    k = k_ref[0, 0, :, :]
+    v = v_ref[0, 0, :, :]
+    p = _softmax_probs(q, k, mask_ref[0, 0, :], scale)
+    o = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
     o_ref[0, 0, :, :] = o.astype(o_ref.dtype)
 
 
@@ -74,29 +172,69 @@ def _pick_q_block(L: int) -> Optional[int]:
     return None
 
 
-def _flash_forward(q, k, v, mask, dtype, interpret: bool = False):
+def supports_fused_bwd(L: int) -> bool:
+    """True when the fully-fused fwd+bwd (and therefore dropout) applies."""
+    return L <= _FUSED_BWD_MAX_LEN and _pick_q_block(L) is not None
+
+
+def _bhld(x):
+    return jnp.transpose(x, (0, 2, 1, 3))
+
+
+def _flash_forward(q, k, v, mask, seed, dtype, rate, interpret: bool):
+    B, L, H, D = q.shape
+    spec_ld = pl.BlockSpec((1, 1, L, D), lambda b, h, *_: (b, h, 0, 0))
+
+    out = pl.pallas_call(
+        functools.partial(_fused_fwd_kernel, scale=1.0 / (D ** 0.5),
+                          rate=rate, heads=H),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H),
+            in_specs=[
+                pl.BlockSpec((1, 1, L), lambda b, h, *_: (b, 0, 0)),  # mask
+                spec_ld, spec_ld, spec_ld,                            # q k v
+            ],
+            out_specs=spec_ld,
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, L, D), dtype),
+        interpret=interpret,
+    )(seed, mask[:, None, :], _bhld(q), _bhld(k), _bhld(v))
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def _flash_backward(q, k, v, mask, seed, g, dtype, rate, interpret: bool):
+    B, L, H, D = q.shape
+    spec_ld = pl.BlockSpec((1, 1, L, D), lambda b, h, *_: (b, h, 0, 0))
+
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_fused_bwd_kernel, scale=1.0 / (D ** 0.5),
+                          rate=rate, heads=H),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H),
+            in_specs=[
+                pl.BlockSpec((1, 1, L), lambda b, h, *_: (b, 0, 0)),  # mask
+                spec_ld, spec_ld, spec_ld, spec_ld,                   # q k v g
+            ],
+            out_specs=[spec_ld, spec_ld, spec_ld],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((B, H, L, D), q.dtype)] * 3,
+        interpret=interpret,
+    )(seed, mask[:, None, :], _bhld(q), _bhld(k), _bhld(v), _bhld(g))
+    return tuple(jnp.transpose(x, (0, 2, 1, 3)) for x in (dq, dk, dv))
+
+
+def _blocked_forward(q, k, v, mask, dtype, interpret: bool):
     B, L, H, D = q.shape
     q_blk = _pick_q_block(L)
     assert q_blk is not None, f"unsupported sequence length {L}"
 
-    scale = 1.0 / (D ** 0.5)
-    grid = (B, H, L // q_blk)
-
-    kernel = functools.partial(_attention_kernel, scale=scale)
-
-    # [B, L, H, D] -> [B, H, L, D]: trailing block dims become [len, D],
-    # satisfying the TPU tile rule; XLA fuses the transposes into the
-    # surrounding projection matmuls.
-    qt = jnp.transpose(q, (0, 2, 1, 3))
-    kt = jnp.transpose(k, (0, 2, 1, 3))
-    vt = jnp.transpose(v, (0, 2, 1, 3))
-    mask3 = mask[:, None, :]
-
     out = pl.pallas_call(
-        kernel,
-        grid=grid,
+        functools.partial(_blocked_fwd_kernel, scale=1.0 / (D ** 0.5)),
+        grid=(B, H, L // q_blk),
         in_specs=[
-            pl.BlockSpec((1, 1, L), lambda b, h, qi: (b, 0, 0)),          # mask
+            pl.BlockSpec((1, 1, L), lambda b, h, qi: (b, 0, 0)),             # mask
             pl.BlockSpec((1, 1, q_blk, D), lambda b, h, qi: (b, h, qi, 0)),  # q
             pl.BlockSpec((1, 1, L, D), lambda b, h, qi: (b, h, 0, 0)),       # k
             pl.BlockSpec((1, 1, L, D), lambda b, h, qi: (b, h, 0, 0)),       # v
@@ -104,36 +242,59 @@ def _flash_forward(q, k, v, mask, dtype, interpret: bool = False):
         out_specs=pl.BlockSpec((1, 1, q_blk, D), lambda b, h, qi: (b, h, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, L, D), dtype),
         interpret=interpret,
-    )(mask3, qt, kt, vt)
+    )(mask[:, None, :], _bhld(q), _bhld(k), _bhld(v))
     return jnp.transpose(out, (0, 2, 1, 3))
 
 
 def _xla_reference(q, k, v, mask, dtype):
-    """Einsum attention used for the backward pass — the dispatcher's XLA
-    path itself, so forward-kernel and backward semantics cannot drift."""
+    """Einsum attention used for the long-sequence backward — the
+    dispatcher's XLA path itself, so kernel and fallback cannot drift."""
     from .attention import _xla_attention
 
     return _xla_attention(q, k, v, mask, dtype=dtype).astype(dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def flash_attention(q, k, v, mask, dtype=jnp.float32, interpret=False):
-    """Fused attention over [B, L, H, D] with a [B, L] key-validity mask."""
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash_core(q, k, v, mask, seed, dtype, rate, interpret):
+    L = q.shape[1]
+    if supports_fused_bwd(L):
+        return _flash_forward(q, k, v, mask, seed, dtype, rate, interpret)
+    assert rate == 0.0, "dropout requires the fully-fused regime (L <= 512)"
+    return _blocked_forward(q, k, v, mask, dtype, interpret)
+
+
+def _fwd(q, k, v, mask, seed, dtype, rate, interpret):
+    out = _flash_core(q, k, v, mask, seed, dtype, rate, interpret)
+    return out, (q, k, v, mask, seed)
+
+
+def _bwd(dtype, rate, interpret, residuals, g):
+    q, k, v, mask, seed = residuals
+    if supports_fused_bwd(q.shape[1]):
+        dq, dk, dv = _flash_backward(
+            q, k, v, mask, seed, g.astype(q.dtype), dtype, rate, interpret
+        )
+        return dq, dk, dv, None, None
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _xla_reference(q_, k_, v_, mask, dtype), q, k, v
+    )
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None, None
+
+
+_flash_core.defvjp(_fwd, _bwd)
+
+
+def flash_attention(q, k, v, mask, seed=None, dtype=jnp.float32, rate=0.0,
+                    interpret=False):
+    """Fused attention over [B, L, H, D] with a [B, L] key-validity mask.
+
+    ``seed``: int32 array of shape (1,) keying the in-kernel dropout mask
+    (ignored when ``rate == 0``). ``rate``: attention-probs dropout rate —
+    requires the fully-fused regime (``supports_fused_bwd(L)``).
+    """
     if mask is None:
         mask = jnp.ones(q.shape[:2], dtype=jnp.int32)
-    return _flash_forward(q, k, v, mask, dtype, interpret)
-
-
-def _fwd(q, k, v, mask, dtype, interpret):
-    out = flash_attention(q, k, v, mask, dtype, interpret)
-    return out, (q, k, v, mask)
-
-
-def _bwd(dtype, interpret, residuals, g):
-    q, k, v, mask = residuals
-    _, vjp = jax.vjp(lambda q_, k_, v_: _xla_reference(q_, k_, v_, mask, dtype), q, k, v)
-    dq, dk, dv = vjp(g)
-    return dq, dk, dv, None
-
-
-flash_attention.defvjp(_fwd, _bwd)
+    if seed is None:
+        seed = jnp.zeros((1,), dtype=jnp.int32)
+    return _flash_core(q, k, v, mask, seed, dtype, rate, interpret)

@@ -9,8 +9,10 @@ import jax.numpy as jnp
 from ml_recipe_tpu.ops.attention import _xla_attention, dot_product_attention
 from ml_recipe_tpu.ops.flash_attention import (
     _pick_q_block,
+    _uniform_grid,
     _xla_reference,
     flash_attention,
+    supports_fused_bwd,
 )
 
 
@@ -24,16 +26,29 @@ def _qkv(B=2, L=128, H=4, D=64, seed=0):
 
 def test_flash_matches_xla_forward():
     q, k, v, mask = _qkv()
-    out_p = flash_attention(q, k, v, mask, jnp.float32, True)  # interpret
+    out_p = flash_attention(q, k, v, mask, dtype=jnp.float32, interpret=True)
     out_x = _xla_reference(q, k, v, mask, jnp.float32)
     np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), atol=1e-5)
 
 
-def test_flash_matches_xla_gradients():
-    q, k, v, mask = _qkv(L=64)
+def test_flash_matches_xla_forward_blocked_long_seq():
+    # L > 512: the q-blocked forward kernel regime (no dropout)
+    q, k, v, mask = _qkv(B=1, L=1024, H=2)
+    assert not supports_fused_bwd(1024)
+    out_p = flash_attention(q, k, v, mask, dtype=jnp.float32, interpret=True)
+    out_x = _xla_reference(q, k, v, mask, jnp.float32)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), atol=1e-5)
+
+
+@pytest.mark.parametrize("L", [64, 1024])
+def test_flash_matches_xla_gradients(L):
+    # L=64 exercises the fused backward KERNEL; L=1024 the XLA-recompute bwd
+    q, k, v, mask = _qkv(B=1, L=L, H=2)
 
     def loss_p(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, mask, jnp.float32, True) ** 2)
+        return jnp.sum(
+            flash_attention(q, k, v, mask, dtype=jnp.float32, interpret=True) ** 2
+        )
 
     def loss_x(q, k, v):
         return jnp.sum(_xla_reference(q, k, v, mask, jnp.float32) ** 2)
@@ -50,13 +65,14 @@ def test_flash_fully_masked_rows_are_finite():
     # built purely from the -1e30 fill; outputs must stay finite
     mask = np.ones((2, 64), np.int32)
     mask[1, :] = 0
-    out = flash_attention(q, k, v, jnp.asarray(mask), jnp.float32, True)
+    out = flash_attention(q, k, v, jnp.asarray(mask), dtype=jnp.float32,
+                          interpret=True)
     assert np.isfinite(np.asarray(out)).all()
 
 
 def test_flash_none_mask():
     q, k, v, _ = _qkv(L=64)
-    out_p = flash_attention(q, k, v, None, jnp.float32, True)
+    out_p = flash_attention(q, k, v, None, dtype=jnp.float32, interpret=True)
     out_x = _xla_reference(q, k, v, None, jnp.float32)
     np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), atol=1e-5)
 
@@ -93,3 +109,83 @@ def test_attention_dropout_path():
         q, k, v, mask, dropout_rate=0.5, dropout_rng=jax.random.key(0)
     )
     np.testing.assert_allclose(np.asarray(out), np.asarray(out2))  # same key
+
+
+# -- in-kernel dropout --------------------------------------------------------
+
+
+def test_uniform_grid_is_uniform_and_deterministic():
+    u = np.asarray(_uniform_grid(jnp.int32(1234), jnp.int32(7), 128))
+    u2 = np.asarray(_uniform_grid(jnp.int32(1234), jnp.int32(7), 128))
+    np.testing.assert_array_equal(u, u2)
+    assert 0.0 <= u.min() and u.max() < 1.0
+    assert abs(u.mean() - 0.5) < 0.02
+    # different head/seed decorrelates
+    v = np.asarray(_uniform_grid(jnp.int32(1234), jnp.int32(8), 128))
+    assert np.mean(u != v) > 0.99
+    for rate in (0.1, 0.5):
+        assert abs(np.mean(u < rate) - rate) < 0.02
+
+
+def test_flash_dropout_deterministic_per_seed():
+    q, k, v, mask = _qkv(L=64)
+    seed = jnp.asarray([42], jnp.int32)
+    out = flash_attention(q, k, v, mask, seed=seed, dtype=jnp.float32,
+                          rate=0.3, interpret=True)
+    out2 = flash_attention(q, k, v, mask, seed=seed, dtype=jnp.float32,
+                           rate=0.3, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+    out3 = flash_attention(q, k, v, mask, seed=jnp.asarray([43], jnp.int32),
+                           dtype=jnp.float32, rate=0.3, interpret=True)
+    assert not np.allclose(np.asarray(out), np.asarray(out3))
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_flash_dropout_preserves_expectation():
+    # inverted dropout: E[out] == no-dropout out; check the batch mean is
+    # close with many heads acting as samples
+    q, k, v, mask = _qkv(B=4, L=128, H=8, seed=3)
+    base = flash_attention(q, k, v, mask, dtype=jnp.float32, interpret=True)
+    outs = [
+        flash_attention(q, k, v, mask, seed=jnp.asarray([s], jnp.int32),
+                        dtype=jnp.float32, rate=0.2, interpret=True)
+        for s in range(8)
+    ]
+    avg = np.mean([np.asarray(o) for o in outs], axis=0)
+    # loose statistical tolerance: 8 samples of a 20% dropout
+    assert np.abs(avg - np.asarray(base)).mean() < 0.05 * np.abs(np.asarray(base)).mean() + 0.05
+
+
+def test_flash_dropout_backward_consistent_with_forward():
+    """The bwd kernel must regenerate the SAME dropout mask as the fwd: for a
+    fixed seed the function is smooth in (q,k,v), so a finite-difference
+    directional derivative must match the analytic vjp."""
+    q, k, v, mask = _qkv(B=1, L=64, H=2, seed=5)
+    seed = jnp.asarray([99], jnp.int32)
+    rng = np.random.default_rng(11)
+    w = jnp.asarray(rng.normal(size=q.shape), jnp.float32)  # output weights
+    dv = jnp.asarray(rng.normal(size=v.shape), jnp.float32)
+
+    def f(v_):
+        out = flash_attention(q, k, v_, mask, seed=seed, dtype=jnp.float32,
+                              rate=0.3, interpret=True)
+        return jnp.sum(out * w)
+
+    g = jax.grad(f)(v)
+    analytic = float(jnp.sum(g * dv))
+    eps = 1e-3
+    numeric = float((f(v + eps * dv) - f(v - eps * dv)) / (2 * eps))
+    assert abs(analytic - numeric) < 1e-2 * max(1.0, abs(numeric))
+
+    # same check through q (exercises the softmax backward path)
+    dq = jnp.asarray(rng.normal(size=q.shape), jnp.float32)
+
+    def fq(q_):
+        out = flash_attention(q_, k, v, mask, seed=seed, dtype=jnp.float32,
+                              rate=0.3, interpret=True)
+        return jnp.sum(out * w)
+
+    gq = jax.grad(fq)(q)
+    analytic_q = float(jnp.sum(gq * dq))
+    numeric_q = float((fq(q + eps * dq) - fq(q - eps * dq)) / (2 * eps))
+    assert abs(analytic_q - numeric_q) < 1e-2 * max(1.0, abs(numeric_q))
