@@ -83,6 +83,13 @@ class Tokenizer:
             self.tokenizer = ByteLevelBPETokenizer(
                 vocab_file=vocab_file, merges_file=merges_file, dropout=dropout
             )
+            # native fast path: deterministic encodes only — BPE-dropout is
+            # stochastic regularization and stays on the Python path
+            if use_native and not dropout:
+                backend = _try_native_backend()
+                if backend is not None:
+                    self._native = backend.NativeByteLevelBPE(vocab_file, merges_file)
+                    logger.info("Using native C++ byte-level BPE backend.")
         else:
             raise NotImplementedError(
                 f"Tokenizer initialization for model {model_name} is not implemented."
@@ -95,7 +102,10 @@ class Tokenizer:
         # ASCII texts (the NQ hot path) take the C++ backend, whose semantics
         # are exactly the Python spec's on that domain; anything with
         # multibyte UTF-8 (accents, CJK) uses the full-Unicode Python path.
-        if self._native is not None and string.isascii():
+        # NUL also routes to Python: it cannot cross the C-string boundary,
+        # and byte-level BPE (unlike WordPiece, which drops it) encodes byte 0
+        # as a real token.
+        if self._native is not None and string.isascii() and "\x00" not in string:
             return self._native.encode(string)
         return self.tokenizer.encode(string)
 

@@ -1,0 +1,417 @@
+// First-party C++ byte-level BPE tokenizer — with qatok/wordpiece.cc, the
+// native replacement for the Rust `tokenizers` dependency the reference wraps
+// in modules/model/model/tokenizer.py:42-49 (SURVEY.md §2.2).
+//
+// Scope: EXACT parity with the Python spec implementation
+// (ml_recipe_tpu/tokenizer/bpe.py) on ASCII text. On that domain the GPT-2
+// pre-split regex
+//   's|'t|'re|'ve|'m|'ll|'d| ?[^\s\d\W]+| ?\d+| ?[^\s\w]+|\s+(?!\S)|\s+
+// reduces to closed ASCII character classes ([^\s\d\W] -> [A-Za-z_],
+// \d -> [0-9], [^\s\w] -> ASCII punctuation) implemented as a hand-rolled
+// scanner below. The facade routes ASCII texts here and anything with
+// multibyte UTF-8 to the Python path. BPE-dropout (stochastic) also stays on
+// the Python path — this backend is the deterministic hot path.
+//
+// C ABI (ctypes-friendly): no exceptions across the boundary, plain int
+// returns, caller-owned buffers.
+
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <mutex>
+#include <shared_mutex>
+#include <sstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// -- GPT-2 byte -> printable-codepoint map (bpe.py bytes_to_unicode) ---------
+
+// Returns, for each byte 0..255, the UTF-8 encoding of its mapped codepoint.
+std::vector<std::string> byte_to_utf8() {
+  bool direct[256] = {false};
+  for (int b = '!'; b <= '~'; ++b) direct[b] = true;
+  for (int b = 0xA1; b <= 0xAC; ++b) direct[b] = true;
+  for (int b = 0xAE; b <= 0xFF; ++b) direct[b] = true;
+
+  auto encode = [](int cp) {
+    std::string s;
+    if (cp < 0x80) {
+      s.push_back((char)cp);
+    } else if (cp < 0x800) {
+      s.push_back((char)(0xC0 | (cp >> 6)));
+      s.push_back((char)(0x80 | (cp & 0x3F)));
+    } else {
+      s.push_back((char)(0xE0 | (cp >> 12)));
+      s.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+      s.push_back((char)(0x80 | (cp & 0x3F)));
+    }
+    return s;
+  };
+
+  std::vector<std::string> table(256);
+  int n = 0;
+  for (int b = 0; b < 256; ++b) {
+    if (direct[b]) {
+      table[b] = encode(b);
+    } else {
+      table[b] = encode(256 + n);
+      ++n;
+    }
+  }
+  return table;
+}
+
+// -- minimal JSON parser for the flat {"token": id, ...} vocab file ----------
+
+struct JsonParser {
+  const std::string& s;
+  size_t i = 0;
+  bool ok = true;
+
+  explicit JsonParser(const std::string& text) : s(text) {}
+
+  void ws() {
+    while (i < s.size() && (s[i] == ' ' || s[i] == '\t' || s[i] == '\n' || s[i] == '\r')) ++i;
+  }
+
+  bool expect(char c) {
+    ws();
+    if (i < s.size() && s[i] == c) {
+      ++i;
+      return true;
+    }
+    ok = false;
+    return false;
+  }
+
+  // JSON string -> UTF-8 std::string (handles \uXXXX incl. surrogate pairs)
+  std::string str() {
+    std::string out;
+    if (!expect('"')) return out;
+    while (i < s.size() && s[i] != '"') {
+      char c = s[i];
+      if (c == '\\') {
+        ++i;
+        if (i >= s.size()) { ok = false; return out; }
+        char e = s[i++];
+        switch (e) {
+          case '"': out.push_back('"'); break;
+          case '\\': out.push_back('\\'); break;
+          case '/': out.push_back('/'); break;
+          case 'b': out.push_back('\b'); break;
+          case 'f': out.push_back('\f'); break;
+          case 'n': out.push_back('\n'); break;
+          case 'r': out.push_back('\r'); break;
+          case 't': out.push_back('\t'); break;
+          case 'u': {
+            if (i + 4 > s.size()) { ok = false; return out; }
+            unsigned cp = (unsigned)std::stoul(s.substr(i, 4), nullptr, 16);
+            i += 4;
+            if (cp >= 0xD800 && cp <= 0xDBFF && i + 6 <= s.size() &&
+                s[i] == '\\' && s[i + 1] == 'u') {
+              unsigned lo = (unsigned)std::stoul(s.substr(i + 2, 4), nullptr, 16);
+              if (lo >= 0xDC00 && lo <= 0xDFFF) {
+                cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+                i += 6;
+              }
+            }
+            if (cp < 0x80) {
+              out.push_back((char)cp);
+            } else if (cp < 0x800) {
+              out.push_back((char)(0xC0 | (cp >> 6)));
+              out.push_back((char)(0x80 | (cp & 0x3F)));
+            } else if (cp < 0x10000) {
+              out.push_back((char)(0xE0 | (cp >> 12)));
+              out.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+              out.push_back((char)(0x80 | (cp & 0x3F)));
+            } else {
+              out.push_back((char)(0xF0 | (cp >> 18)));
+              out.push_back((char)(0x80 | ((cp >> 12) & 0x3F)));
+              out.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+              out.push_back((char)(0x80 | (cp & 0x3F)));
+            }
+            break;
+          }
+          default: ok = false; return out;
+        }
+      } else {
+        out.push_back(c);
+        ++i;
+      }
+    }
+    expect('"');
+    return out;
+  }
+
+  long num() {
+    ws();
+    size_t start = i;
+    if (i < s.size() && (s[i] == '-' || s[i] == '+')) ++i;
+    while (i < s.size() && s[i] >= '0' && s[i] <= '9') ++i;
+    if (start == i) { ok = false; return 0; }
+    return std::stol(s.substr(start, i - start));
+  }
+};
+
+bool parse_vocab_json(const std::string& path,
+                      std::unordered_map<std::string, int32_t>* vocab) {
+  std::ifstream in(path);
+  if (!in.good()) return false;
+  std::stringstream buf;
+  buf << in.rdbuf();
+  std::string text = buf.str();
+
+  JsonParser p(text);
+  if (!p.expect('{')) return false;
+  p.ws();
+  if (p.i < text.size() && text[p.i] == '}') return true;  // empty object
+  while (p.ok) {
+    std::string key = p.str();
+    if (!p.expect(':')) return false;
+    long val = p.num();
+    if (!p.ok) return false;
+    (*vocab)[key] = (int32_t)val;
+    p.ws();
+    if (p.i < text.size() && text[p.i] == ',') {
+      ++p.i;
+      continue;
+    }
+    break;
+  }
+  return p.ok && p.expect('}');
+}
+
+// -- tokenizer state ---------------------------------------------------------
+
+struct Bpe {
+  std::unordered_map<std::string, int32_t> vocab;
+  std::unordered_map<std::string, int32_t> merge_ranks;  // "a\nb" -> rank
+  std::vector<std::string> byte_map = byte_to_utf8();
+  int32_t unk_id = 0;
+
+  // token -> BPE pieces cache; loaders encode from a thread pool (ctypes
+  // releases the GIL), so guard with a read-write lock
+  std::unordered_map<std::string, std::vector<std::string>> cache;
+  std::shared_mutex cache_mu;
+};
+
+inline bool is_ascii_space(unsigned char c) {
+  // Python str \s on the ASCII domain: [ \t\n\r\f\v]
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' || c == '\v';
+}
+
+inline bool is_letter(unsigned char c) {  // [^\s\d\W] == [A-Za-z_] on ASCII
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') || c == '_';
+}
+
+inline bool is_digit(unsigned char c) { return c >= '0' && c <= '9'; }
+
+inline bool is_punct(unsigned char c) {
+  // [^\s\w]: anything that is not whitespace and not a word char — note this
+  // INCLUDES ASCII control chars, exactly like the Python regex.
+  return !is_ascii_space(c) && !is_letter(c) && !is_digit(c);
+}
+
+// GPT-2 pre-split for ASCII text (bpe.py _GPT2_SPLIT semantics). Appends
+// byte ranges [start, end) of `text` to `pieces`.
+void gpt2_split(const std::string& text,
+                std::vector<std::pair<size_t, size_t>>* pieces) {
+  const size_t n = text.size();
+  size_t i = 0;
+  static const char* kContr[] = {"'s", "'t", "'re", "'ve", "'m", "'ll", "'d"};
+  while (i < n) {
+    unsigned char c = (unsigned char)text[i];
+
+    // contractions (tried first by the regex alternation, lowercase only)
+    if (c == '\'') {
+      bool matched = false;
+      for (const char* suf : kContr) {
+        size_t len = std::strlen(suf);
+        if (i + len <= n && text.compare(i, len, suf) == 0) {
+          pieces->emplace_back(i, i + len);
+          i += len;
+          matched = true;
+          break;
+        }
+      }
+      if (matched) continue;
+      // fall through: bare apostrophe joins the punctuation class below
+    }
+
+    if (is_ascii_space(c)) {
+      size_t j = i;
+      while (j < n && is_ascii_space((unsigned char)text[j])) ++j;
+      if (j == n) {
+        pieces->emplace_back(i, j);  // \s+(?!\S): trailing whitespace run
+        break;
+      }
+      if (j - i > 1) {
+        // run minus one: the last space binds to the following token
+        pieces->emplace_back(i, j - 1);
+        i = j - 1;
+        continue;
+      }
+      // single space before a visible char: consumed by ` ?X+` below
+    }
+
+    size_t start = i;
+    size_t k = i + (is_ascii_space(c) ? 1 : 0);  // optional leading space
+    unsigned char d = (unsigned char)text[k];
+    if (is_letter(d)) {
+      while (k < n && is_letter((unsigned char)text[k])) ++k;
+    } else if (is_digit(d)) {
+      while (k < n && is_digit((unsigned char)text[k])) ++k;
+    } else {
+      while (k < n && is_punct((unsigned char)text[k])) ++k;
+    }
+    pieces->emplace_back(start, k);
+    i = k;
+  }
+}
+
+// Greedy min-rank BPE merge loop (bpe.py _bpe), over UTF-8 piece strings.
+std::vector<std::string> bpe_word(Bpe* bpe, const std::string& mapped,
+                                  const std::vector<std::string>& symbols) {
+  {
+    std::shared_lock<std::shared_mutex> lock(bpe->cache_mu);
+    auto it = bpe->cache.find(mapped);
+    if (it != bpe->cache.end()) return it->second;
+  }
+
+  std::vector<std::string> word = symbols;
+  std::string key;
+  while (word.size() > 1) {
+    int32_t best_rank = INT32_MAX;
+    size_t best_i = 0;
+    std::string best_merged;
+    for (size_t i = 0; i + 1 < word.size(); ++i) {
+      key.assign(word[i]);
+      key.push_back('\n');  // '\n' cannot appear in mapped symbols
+      key.append(word[i + 1]);
+      auto it = bpe->merge_ranks.find(key);
+      if (it != bpe->merge_ranks.end() && it->second < best_rank) {
+        best_rank = it->second;
+        best_i = i;
+        best_merged = word[i] + word[i + 1];
+      }
+    }
+    if (best_rank == INT32_MAX) break;
+    // merge EVERY occurrence of the best pair left-to-right (bpe.py:89-98)
+    const std::string a = word[best_i];
+    const std::string b = word[best_i + 1];
+    std::vector<std::string> merged;
+    merged.reserve(word.size());
+    size_t i = 0;
+    while (i < word.size()) {
+      if (i + 1 < word.size() && word[i] == a && word[i + 1] == b) {
+        merged.push_back(a + b);
+        i += 2;
+      } else {
+        merged.push_back(word[i]);
+        ++i;
+      }
+    }
+    word.swap(merged);
+  }
+
+  {
+    std::unique_lock<std::shared_mutex> lock(bpe->cache_mu);
+    bpe->cache.emplace(mapped, word);
+  }
+  return word;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* qatok_bpe_new(const char* vocab_path, const char* merges_path) {
+  auto* bpe = new Bpe();
+  if (!parse_vocab_json(vocab_path, &bpe->vocab)) {
+    delete bpe;
+    return nullptr;
+  }
+
+  std::ifstream merges(merges_path);
+  if (!merges.good()) {
+    delete bpe;
+    return nullptr;
+  }
+  // parity with bpe.py:55-61: strip(), skip blanks and #version, rank by
+  // count of ACCEPTED lines, key is (first-space-split a, rest b)
+  std::string line;
+  while (std::getline(merges, line)) {
+    while (!line.empty() && (line.back() == '\r' || line.back() == '\n' ||
+                             line.back() == ' ' || line.back() == '\t'))
+      line.pop_back();
+    size_t start = 0;
+    while (start < line.size() &&
+           (line[start] == ' ' || line[start] == '\t'))
+      ++start;
+    if (start > 0) line.erase(0, start);
+    if (line.empty() || line.rfind("#version", 0) == 0) continue;
+    size_t sp = line.find(' ');
+    std::string a = (sp == std::string::npos) ? line : line.substr(0, sp);
+    std::string b = (sp == std::string::npos) ? "" : line.substr(sp + 1);
+    std::string key = a;
+    key.push_back('\n');
+    key.append(b);
+    // parity with `ranks[(a,b)] = len(ranks)`: a duplicate line overwrites
+    // with the CURRENT dict size (rhs evaluated before insertion)
+    int32_t rank = (int32_t)bpe->merge_ranks.size();
+    bpe->merge_ranks[key] = rank;
+  }
+
+  auto unk = bpe->vocab.find("<unk>");
+  bpe->unk_id = unk == bpe->vocab.end() ? 0 : unk->second;
+  return bpe;
+}
+
+void qatok_bpe_free(void* handle) { delete static_cast<Bpe*>(handle); }
+
+int32_t qatok_bpe_vocab_size(void* handle) {
+  return (int32_t)static_cast<Bpe*>(handle)->vocab.size();
+}
+
+int32_t qatok_bpe_token_to_id(void* handle, const char* token) {
+  auto* bpe = static_cast<Bpe*>(handle);
+  auto it = bpe->vocab.find(token);
+  return it == bpe->vocab.end() ? -1 : it->second;
+}
+
+// Encode `text` (must be ASCII; caller pre-checks) into `out` (capacity
+// `cap`). Returns the id count, or -(needed) when cap is too small.
+int32_t qatok_bpe_encode(void* handle, const char* text, int32_t* out,
+                         int32_t cap) {
+  auto* bpe = static_cast<Bpe*>(handle);
+  const std::string s(text);
+
+  std::vector<std::pair<size_t, size_t>> spans;
+  gpt2_split(s, &spans);
+
+  std::vector<int32_t> ids;
+  std::string mapped;
+  std::vector<std::string> symbols;
+  for (auto [lo, hi] : spans) {
+    mapped.clear();
+    symbols.clear();
+    for (size_t i = lo; i < hi; ++i) {
+      const std::string& u = bpe->byte_map[(unsigned char)s[i]];
+      mapped.append(u);
+      symbols.push_back(u);
+    }
+    for (const std::string& piece : bpe_word(bpe, mapped, symbols)) {
+      auto it = bpe->vocab.find(piece);
+      ids.push_back(it == bpe->vocab.end() ? bpe->unk_id : it->second);
+    }
+  }
+
+  if ((int32_t)ids.size() > cap) return -(int32_t)ids.size();
+  std::memcpy(out, ids.data(), ids.size() * sizeof(int32_t));
+  return (int32_t)ids.size();
+}
+
+}  // extern "C"

@@ -71,3 +71,32 @@ def write_corpus(tmp_path: Path, lines) -> Path:
         for line in lines:
             fh.write(json.dumps(line) + "\n")
     return raw
+
+
+def write_bpe_files(tmp_path):
+    """Tiny byte-level BPE vocab.json + merges.txt covering common English
+    merges over the GPT-2 byte alphabet (json.dump with ensure_ascii exercises
+    the \\uXXXX path of the native JSON parser)."""
+    import json
+
+    from ml_recipe_tpu.tokenizer.bpe import bytes_to_unicode
+
+    merges = [
+        ("t", "h"), ("th", "e"), ("Ġ", "t"), ("Ġt", "he"),
+        ("i", "n"), ("a", "n"), ("an", "d"), ("Ġ", "a"),
+        ("e", "r"), ("o", "n"), ("1", "2"), ("12", "3"),
+        ("'", "s"), ("Ġ", "the"), (".", "."), ("..", "."),
+    ]
+    vocab = {"<unk>": 0, "<pad>": 1, "<s>": 2, "</s>": 3, "<mask>": 4}
+    for ch in sorted(set(bytes_to_unicode().values())):
+        vocab.setdefault(ch, len(vocab))
+    for a, b in merges:
+        vocab.setdefault(a + b, len(vocab))
+
+    vocab_file = tmp_path / "bpe_vocab.json"
+    merges_file = tmp_path / "bpe_merges.txt"
+    vocab_file.write_text(json.dumps(vocab))  # ensure_ascii -> \uXXXX escapes
+    merges_file.write_text(
+        "#version: 0.2\n" + "\n".join(f"{a} {b}" for a, b in merges) + "\n"
+    )
+    return vocab_file, merges_file

@@ -279,3 +279,114 @@ def test_wordpiece_native_vocab_parity_crlf_and_duplicates(tmp_path):
     assert len(py) == len(cc)
     for tok in ["the", "quick", "fox", "cr_only", "last", "the\r", "missing"]:
         assert cc.token_to_id(tok) == py.vocab.get(tok), repr(tok)
+
+
+def _random_bpe_text(rng, n=40):
+    pieces = []
+    for _ in range(n):
+        r = rng.random()
+        if r < 0.45:
+            pieces.append(rng.choice(["the", "and", "in", "on", "other",
+                                      "anthem", "123", "12345", "don't", "it's"]))
+        elif r < 0.6:
+            pieces.append("".join(rng.choices(string.ascii_letters + "_", k=rng.randint(1, 10))))
+        elif r < 0.75:
+            pieces.append(rng.choice(["...", "!?", "(", ")", "'", "\"", ",", "-"]))
+        elif r < 0.85:
+            pieces.append(str(rng.randint(0, 99999)))
+        else:
+            pieces.append(rng.choice(["\t", "  ", "\n", "   ", " "]))
+        if rng.random() < 0.3:
+            pieces.append(" ")
+    return "".join(pieces)
+
+
+def test_bpe_native_matches_python(tmp_path):
+    if not _native_available():
+        pytest.skip("native qatok not built")
+    from helpers import write_bpe_files
+
+    from ml_recipe_tpu.tokenizer.bpe import ByteLevelBPETokenizer
+    from ml_recipe_tpu.tokenizer.native import NativeByteLevelBPE
+
+    vocab_file, merges_file = write_bpe_files(tmp_path)
+    py = ByteLevelBPETokenizer(str(vocab_file), str(merges_file))
+    cc = NativeByteLevelBPE(str(vocab_file), str(merges_file))
+
+    assert len(py) == len(cc)
+    assert cc.token_to_id("<unk>") == py.token_to_id("<unk>")
+    assert cc.token_to_id("Ġthe") == py.token_to_id("Ġthe")
+
+    rng = random.Random(0)
+    for trial in range(300):
+        text = _random_bpe_text(rng)
+        assert cc.encode(text) == py.encode(text), f"trial {trial}: {text!r}"
+
+
+def test_bpe_native_edge_cases(tmp_path):
+    if not _native_available():
+        pytest.skip("native qatok not built")
+    from helpers import write_bpe_files
+
+    from ml_recipe_tpu.tokenizer.bpe import ByteLevelBPETokenizer
+    from ml_recipe_tpu.tokenizer.native import NativeByteLevelBPE
+
+    vocab_file, merges_file = write_bpe_files(tmp_path)
+    py = ByteLevelBPETokenizer(str(vocab_file), str(merges_file))
+    cc = NativeByteLevelBPE(str(vocab_file), str(merges_file))
+
+    cases = [
+        "",
+        " ",
+        "   ",
+        "\t\n\r\x0b\x0c",
+        "the",
+        " the",
+        "  the  and  ",
+        "the's't're've'm'll'd",
+        "'S 'D",                 # uppercase: NOT contractions
+        "a'b",
+        "word\x01\x02ctrl",      # control chars are [^\s\w] punctuation
+        "...!?...",
+        "tab\tand space",
+        "trailing space ",
+        "123the456",
+        "_under_score_",
+    ]
+    for text in cases:
+        assert cc.encode(text) == py.encode(text), repr(text)
+
+
+def test_bpe_facade_routes_ascii_to_native(tmp_path):
+    if not _native_available():
+        pytest.skip("native qatok not built")
+    from helpers import write_bpe_files
+
+    from ml_recipe_tpu.tokenizer import Tokenizer
+
+    vocab_file, merges_file = write_bpe_files(tmp_path)
+    tok = Tokenizer("roberta", str(vocab_file), merges_file=str(merges_file))
+    assert tok._native is not None
+    assert tok.encode("the man and 123") == tok.tokenizer.encode("the man and 123")
+    # non-ASCII goes to Python; result still well-formed
+    assert isinstance(tok.encode("café"), list)
+
+    # dropout: stochastic path must NOT bind the native backend
+    tok_d = Tokenizer("roberta", str(vocab_file), merges_file=str(merges_file),
+                      dropout=0.1)
+    assert tok_d._native is None
+
+
+def test_bpe_facade_routes_nul_to_python(tmp_path):
+    """Byte-level BPE encodes byte 0 as a real token; NUL can't cross the
+    C-string boundary, so the facade must use the Python path for it."""
+    if not _native_available():
+        pytest.skip("native qatok not built")
+    from helpers import write_bpe_files
+
+    from ml_recipe_tpu.tokenizer import Tokenizer
+
+    vocab_file, merges_file = write_bpe_files(tmp_path)
+    tok = Tokenizer("roberta", str(vocab_file), merges_file=str(merges_file))
+    assert tok.encode("a\x00b") == tok.tokenizer.encode("a\x00b")
+    assert len(tok.encode("a\x00b")) == 3  # 'a', byte-0 token, 'b'
