@@ -1,0 +1,29 @@
+# TPU training image (parity target: reference Dockerfile — which built apex
+# with CUDA extensions and pinned the Rust tokenizers wheel; neither exists
+# here: bf16 is native on TPU and the tokenizer is first-party C++, built
+# below with plain g++).
+FROM python:3.12-slim
+
+RUN apt-get -qq update && \
+    DEBIAN_FRONTEND=noninteractive apt-get -qq install --no-install-recommends \
+        g++ make git && \
+    apt-get -qq clean && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /project
+
+# TPU runtime: libtpu comes through the jax[tpu] extra.
+RUN pip install --no-cache-dir -U pip && \
+    pip install --no-cache-dir "jax[tpu]" \
+        -f https://storage.googleapis.com/jax-releases/libtpu_releases.html && \
+    pip install --no-cache-dir flax optax einops numpy tqdm
+
+COPY pyproject.toml .
+COPY ml_recipe_tpu ./ml_recipe_tpu
+COPY native ./native
+COPY config ./config
+COPY scripts ./scripts
+
+# first-party native helpers: C++ WordPiece tokenizer + host coordination
+RUN make -C native && pip install --no-cache-dir -e .
+
+ENV PYTHONPATH=/project

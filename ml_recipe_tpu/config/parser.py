@@ -370,6 +370,10 @@ def get_trainer_parser() -> ConfigArgumentParser:
                         help="Not restore optimizer and scheduler from checkpoint.")
 
     parser.add_argument("--debug", action="store_true", help="Debug mode.")
+    parser.add_argument("--trace", action="store_true",
+                        help="Dump an xplane device trace of train steps 2-4 "
+                             "into <dump_dir>/board/<experiment>/trace "
+                             "(view with TensorBoard/XProf).")
     parser.add_argument("--dummy_dataset", action="store_true",
                         help="Use generated dataset instead real data.")
 

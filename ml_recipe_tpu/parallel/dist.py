@@ -202,13 +202,17 @@ def serve_readiness(port: int, world_size: int, *, timeout_s: int = 300) -> bool
                 return False
             with conn:
                 try:
-                    # clamp to the remaining deadline: a byte-dripping client
-                    # must not stretch the barrier past timeout_s
-                    conn.settimeout(
-                        max(min(2.0, deadline - _time.monotonic()), 0.001)
-                    )
+                    # per-CONNECTION deadline (2s, clamped to the global one):
+                    # settimeout bounds each recv individually and a byte-
+                    # dripping client would re-arm it per byte, so re-derive
+                    # the budget before every recv
+                    conn_deadline = min(_time.monotonic() + 2.0, deadline)
                     hello = b""
                     while len(hello) < 5:
+                        left = conn_deadline - _time.monotonic()
+                        if left <= 0:
+                            break
+                        conn.settimeout(left)
                         chunk = conn.recv(5 - len(hello))
                         if not chunk:
                             break

@@ -97,6 +97,11 @@ class Trainer:
     debug: bool = False
     seed: int = 0
 
+    # xplane trace of a few steady-state steps (SURVEY.md §5 tracing):
+    # directory to dump to, or None to disable. Steps 2-4 of epoch 1 are
+    # captured (past compilation, one full accumulation cycle each).
+    trace_dir: Any = None
+
     def __post_init__(self):
         if self.mesh is None:
             self.mesh = build_mesh()
@@ -338,13 +343,24 @@ class Trainer:
             tqdm_data = tqdm(iterator, desc=f"Train (epoch #{epoch_i} / {self.n_epochs})")
             iterator = tqdm_data
 
-        for inputs, labels in iterator:
+        trace_started = trace_stopped = self.trace_dir is None  # disabled = done
+        for step_i, (inputs, labels) in enumerate(iterator):
+            if not trace_started and epoch_i == 1 and step_i == 2:
+                jax.profiler.start_trace(str(self.trace_dir))
+                trace_started = True
+
             inputs = self._global_batch(self._split_micro(inputs), leading_accum=True)
             labels = self._global_batch(self._split_micro(labels), leading_accum=True)
 
             self.params, self.opt_state, values = self._jit_train_step(
                 self.params, self.opt_state, inputs, labels, self.global_step
             )
+
+            if trace_started and not trace_stopped and step_i >= 4:
+                jax.block_until_ready(values)
+                jax.profiler.stop_trace()
+                trace_stopped = True
+                logger.info(f"Device trace (steps 2-4) written to {self.trace_dir}.")
 
             host_values = jax.device_get(values)
             for k, v in host_values.items():
@@ -362,6 +378,10 @@ class Trainer:
             if self.debug:
                 logger.info("Training was interrupted because of debug mode.")
                 break
+
+        if trace_started and not trace_stopped:  # epoch shorter than 5 steps
+            jax.profiler.stop_trace()
+            logger.info(f"Device trace written to {self.trace_dir}.")
 
         if self.writer is not None:
             self.writer.flush()  # survive preemption with events intact

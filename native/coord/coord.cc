@@ -124,18 +124,21 @@ int qacoord_serve(int port, int world_size, int timeout_s) {
       close(listener);
       return -1;  // timeout / error
     }
-    // clamp the per-connection read budget to the remaining deadline so a
-    // byte-dripping client can't stretch the barrier past timeout_s
-    remaining_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
-                       deadline - std::chrono::steady_clock::now())
-                       .count();
-    long conn_ms = remaining_ms < 2000 ? (remaining_ms > 1 ? remaining_ms : 1)
-                                       : 2000;
-    struct timeval ctv {conn_ms / 1000, (conn_ms % 1000) * 1000};
-    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &ctv, sizeof(ctv));
+    // per-CONNECTION deadline (2s, clamped to the global one): SO_RCVTIMEO
+    // bounds each read individually and a byte-dripping client would re-arm
+    // it per byte, so re-derive the budget before every read
+    auto conn_deadline = std::chrono::steady_clock::now() +
+                         std::chrono::milliseconds(2000);
+    if (deadline < conn_deadline) conn_deadline = deadline;
     char hello[5];
     ssize_t got = 0;
     while (got < 5) {  // stray clients / RSTs just drop out of the loop
+      long left_ms = std::chrono::duration_cast<std::chrono::milliseconds>(
+                         conn_deadline - std::chrono::steady_clock::now())
+                         .count();
+      if (left_ms <= 0) break;
+      struct timeval ctv {left_ms / 1000, (left_ms % 1000) * 1000};
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &ctv, sizeof(ctv));
       ssize_t n = read(fd, hello + got, 5 - got);
       if (n <= 0) break;
       got += n;

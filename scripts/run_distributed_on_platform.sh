@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Launch a WORLD_SIZE-host distributed run on a neuro-flow-style platform
+# (parity target: reference scripts/run_distributed_on_platform.sh).
+#
+# Protocol differences from the reference:
+# - the master's address is scraped once from job status (same as reference),
+#   but workers then block on the native qacoord readiness handshake inside
+#   worker.sh instead of racing the NCCL rendezvous;
+# - each job is one HOST process (SPMD covers its chips); world_size counts
+#   hosts, not GPUs.
+set -euo pipefail
+
+WORLD_SIZE="${WORLD_SIZE:-2}"
+
+echo "Running the master job..."
+neuro-flow run distributed_training --param world_size "$WORLD_SIZE" \
+    --param name distributed-tpu-master
+
+MASTER_IP=$(neuro status distributed-tpu-master \
+    | awk '/Internal Hostname / {print $3}' | head -1)
+
+echo "Running worker jobs..."
+for ((i = 1; i < WORLD_SIZE; i++)); do
+    neuro-flow run distributed_training --param world_size "$WORLD_SIZE" \
+        --param name "distributed-tpu-worker-${i}" \
+        --param master_ip "$MASTER_IP" --param local_rank "$i"
+done
+
+echo "All jobs were initialized."
+echo "Streaming logs of the master job"
+neuro logs distributed-tpu-master

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Single-host run (parity target: reference scripts/run_distributed_on_single_node.sh).
+# The reference needed a loopback NCCL rendezvous + mp.spawn to use >1 GPU on
+# one node; under SPMD a single process already drives every local TPU chip
+# through the mesh, so this is just the train CLI.
+set -euo pipefail
+exec python -m ml_recipe_tpu.cli.train "$@"

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Launch training on every host of a Cloud TPU pod slice.
+#
+# Parity target: reference scripts/run_distributed_on_platform.sh (master job
+# + IP scrape + worker fan-out). On a TPU pod none of that protocol is needed:
+# every host runs the SAME command and jax.distributed.initialize() discovers
+# the coordinator from the TPU metadata, so the launcher reduces to an
+# all-workers ssh fan-out.
+#
+# usage: scripts/run_on_tpu_pod.sh <tpu-name> <zone> [train args...]
+set -euo pipefail
+
+TPU_NAME="${1:?usage: run_on_tpu_pod.sh <tpu-name> <zone> [train args...]}"
+ZONE="${2:?usage: run_on_tpu_pod.sh <tpu-name> <zone> [train args...]}"
+shift 2
+
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+    --command "cd \$(dirname \$(python -c 'import ml_recipe_tpu,os;print(os.path.dirname(ml_recipe_tpu.__path__[0]))')) && python -m ml_recipe_tpu.cli.train $*"

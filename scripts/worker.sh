@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Per-host SPMD worker (parity target: reference scripts/worker.sh — env
+# contract MASTER_IP/MASTER_PORT/LOCAL_RANK/WORLD_SIZE -> CLI flags; worker.sh
+# self-resolved the master hostname when MASTER_IP=0).
+#
+# TPU redesign: ONE process per host joins the world via
+# jax.distributed.initialize (no NCCL, no per-GPU spawn). Before that, the
+# native qacoord helper runs an explicit readiness handshake so workers block
+# until the coordinator is reachable instead of crash-looping on a TCP
+# connect (the reference leaned on NCCL's rendezvous retries for this).
+set -euo pipefail
+
+LOCAL_RANK="${LOCAL_RANK:-0}"
+WORLD_SIZE="${WORLD_SIZE:-1}"
+MASTER_PORT="${MASTER_PORT:-9080}"
+MASTER_IP="${MASTER_IP:-0}"
+
+# Coordinator self-resolution: rank 0 with MASTER_IP=0 serves on its own
+# hostname (the reference's "$(hostname).platform-jobs" convention is platform
+# DNS; plain hostname works on TPU VMs and in-cluster DNS alike).
+if [ "$MASTER_IP" = "0" ]; then
+    MASTER_IP="$(hostname)"
+fi
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+QACOORD="${REPO_ROOT}/native/build/qacoord"
+READY_PORT=$((MASTER_PORT + 1))
+
+if [ "$WORLD_SIZE" -gt 1 ] && [ -x "$QACOORD" ]; then
+    if [ "$LOCAL_RANK" = "0" ]; then
+        # Readiness barrier runs in the background while the coordinator
+        # process starts; jax.distributed's own handshake finishes the job.
+        "$QACOORD" serve "$READY_PORT" "$WORLD_SIZE" 600 &
+    else
+        "$QACOORD" wait "$MASTER_IP" "$READY_PORT" 600 "$LOCAL_RANK" || true
+    fi
+fi
+
+exec python -m ml_recipe_tpu.cli.train \
+    --local_rank "$LOCAL_RANK" \
+    --dist_world_size "$WORLD_SIZE" \
+    --dist_backend xla \
+    --dist_init_method "tcp://${MASTER_IP}:${MASTER_PORT}" \
+    "$@"
