@@ -15,4 +15,4 @@ ZONE="${2:?usage: run_on_tpu_pod.sh <tpu-name> <zone> [train args...]}"
 shift 2
 
 gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
-    --command "cd \$(dirname \$(python -c 'import ml_recipe_tpu,os;print(os.path.dirname(ml_recipe_tpu.__path__[0]))')) && python -m ml_recipe_tpu.cli.train $*"
+    --command "cd \$(python -c 'import ml_recipe_tpu,os;print(os.path.dirname(ml_recipe_tpu.__path__[0]))') && python -m ml_recipe_tpu.cli.train $*"

@@ -26,6 +26,13 @@ REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 QACOORD="${REPO_ROOT}/native/build/qacoord"
 READY_PORT=$((MASTER_PORT + 1))
 
+# Platform jobs mount the repo from storage, shadowing any binaries baked
+# into the image — (re)build the native helpers in place when missing
+# (seconds with g++; training proceeds without them if the toolchain is absent).
+if [ ! -x "$QACOORD" ] && command -v g++ >/dev/null 2>&1; then
+    make -C "$REPO_ROOT/native" >/dev/null 2>&1 || true
+fi
+
 if [ "$WORLD_SIZE" -gt 1 ] && [ -x "$QACOORD" ]; then
     if [ "$LOCAL_RANK" = "0" ]; then
         # Readiness barrier runs in the background while the coordinator
