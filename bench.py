@@ -75,7 +75,7 @@ def main() -> None:
         TP(), trainer.params, num_training_steps=10_000, max_grad_norm=1.0,
         warmup_coef=0.0,
     )
-    trainer.opt_state = jax.jit(trainer.optimizer.init)(trainer.params)
+    trainer.init_opt_state()
     step_fn = trainer._build_train_step()
 
     G = args.batch_split

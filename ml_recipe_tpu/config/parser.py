@@ -347,6 +347,11 @@ def get_trainer_parser() -> ConfigArgumentParser:
 
     parser.add_argument("--max_grad_norm", type=float, default=1,
                         help="Max global norm of the gradients")
+    parser.add_argument("--shard_optimizer", action="store_true",
+                        help="ZeRO-1: shard optimizer moments over the mesh "
+                             "data axis (memory 1/N; XLA all-gathers the "
+                             "sharded updates). The reference replicates "
+                             "optimizer state per process.")
     parser.add_argument("--sync_bn", action="store_true",
                         help="Cross-replica normalization statistics sync (reference "
                              "SyncBN flag; BERT has LayerNorm so this is a no-op "

@@ -70,6 +70,48 @@ def param_pspecs(params, mesh: Mesh) -> dict:
     return jax.tree_util.tree_map_with_path(spec_for, params)
 
 
+def zero_pspecs(state_shapes, mesh: Mesh, *, min_size: int = 16384):
+    """ZeRO-1 PartitionSpec tree for an optimizer-state (shape) tree.
+
+    The reference replicates optimizer state on every replica (SURVEY.md
+    §2.3 'full replica optimizer state'); here each moment tensor is sharded
+    over the ``data`` axis so its memory scales 1/N with data parallelism —
+    XLA all-gathers the (sharded) param updates it produces, which is the
+    ZeRO-1 communication pattern.
+
+    Works on the output of ``jax.eval_shape(optimizer.init, params)``. Leaf
+    paths inside optax states end with the param path (e.g.
+    ``.../mu/encoder/layer_0/attention/query/kernel``), so the tensor-
+    parallel rules apply unchanged; the data axis is then laid on the
+    largest remaining dim divisible by its size. Small leaves (< min_size
+    elements, e.g. biases and scalars like ``count``) stay replicated —
+    sharding them buys nothing and costs collective latency.
+    """
+    data_size = mesh.shape.get(DATA_AXIS, 1)
+    has_tp = MODEL_AXIS in mesh.axis_names and mesh.shape[MODEL_AXIS] > 1
+
+    def spec_for(path, leaf):
+        shape = tuple(getattr(leaf, "shape", ()))
+        axes = [None] * len(shape)
+        if has_tp:
+            path_s = _path_str(path)
+            for pattern, spec in TP_RULES:
+                if re.match(pattern, path_s):
+                    axes = list(spec) + [None] * (len(shape) - len(spec))
+                    break
+        if data_size > 1 and int(np.prod(shape or (0,))) >= min_size:
+            free = [
+                (dim, i) for i, dim in enumerate(shape)
+                if axes[i] is None and dim % data_size == 0
+            ]
+            if free:
+                _, i = max(free)
+                axes[i] = DATA_AXIS
+        return P(*axes)
+
+    return jax.tree_util.tree_map_with_path(spec_for, state_shapes)
+
+
 def is_single_device(mesh: Mesh) -> bool:
     """True when the mesh is one device — GSPMD placement is skipped entirely
     then: COMMITTED arrays (NamedSharding or explicit device) force a compile/

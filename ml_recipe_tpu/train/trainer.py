@@ -110,6 +110,12 @@ class Trainer:
     # dropout does not care about.
     prng_impl: str = "rbg"
 
+    # ZeRO-1: shard optimizer moments over the mesh data axis (memory 1/N;
+    # the reference keeps a full replica per process, SURVEY.md §2.3). XLA
+    # all-gathers the sharded param updates — the ZeRO-1 pattern.
+    shard_optimizer: bool = False
+    zero_min_size: int = 16384      # leaves smaller than this stay replicated
+
     def __post_init__(self):
         if self.mesh is None:
             self.mesh = build_mesh()
@@ -181,6 +187,7 @@ class Trainer:
         self.optimizer = None
         self.opt_state = None
         self.scheduler = None
+        self._zero_shardings = None
         if self.train_dataloader is not None and self.trainer_params is not None:
             micro_batch = self.train_batch_size // self.batch_split
             data_size = int(
@@ -208,15 +215,39 @@ class Trainer:
                 max_grad_norm=self.max_grad_norm,
                 warmup_coef=self.warmup_coef,
             )
-            # jit so opt-state leaves inherit the param shardings (GSPMD
-            # propagation) instead of landing unsharded on device 0.
-            self.opt_state = jax.jit(self.optimizer.init)(self.params)
+            self.init_opt_state()
 
         self.global_step = 0
         self.writer = init_writer(self.is_primary, self.writer_dir)
 
         self._jit_train_step = None
         self._jit_eval_step = None
+
+    def init_opt_state(self):
+        """(Re)initialize ``opt_state`` from ``self.optimizer``, honoring
+        ``shard_optimizer`` (ZeRO-1). Also used by callers that build the
+        optimizer themselves (bench, dry-run)."""
+        if (
+            self.shard_optimizer
+            and not is_single_device(self.mesh)
+            and int(self.mesh.shape.get("data", 1)) > 1
+        ):
+            from ..parallel.sharding import zero_pspecs
+
+            state_shapes = jax.eval_shape(self.optimizer.init, self.params)
+            self._zero_shardings = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(self.mesh, spec),
+                zero_pspecs(state_shapes, self.mesh, min_size=self.zero_min_size),
+            )
+            self.opt_state = jax.jit(
+                self.optimizer.init, out_shardings=self._zero_shardings
+            )(self.params)
+            logger.info("ZeRO-1: optimizer state sharded over the data axis.")
+        else:
+            # jit so opt-state leaves inherit the param shardings (GSPMD
+            # propagation) instead of landing unsharded on device 0.
+            self._zero_shardings = None
+            self.opt_state = jax.jit(self.optimizer.init)(self.params)
 
     # -- batch placement ------------------------------------------------------
 
@@ -291,6 +322,13 @@ class Trainer:
             values = jax.tree_util.tree_map(lambda v: v * inv, values)
 
             updates, new_opt_state = optimizer.update(grads, opt_state, params)
+            if self._zero_shardings is not None:
+                # keep the ZeRO layout stable across steps: without the
+                # constraint GSPMD may re-layout the donated state to match
+                # whatever the update fusion preferred
+                new_opt_state = jax.lax.with_sharding_constraint(
+                    new_opt_state, self._zero_shardings
+                )
             new_params = jax.tree_util.tree_map(
                 lambda p, u: (p + u).astype(p.dtype), params, updates
             )
