@@ -137,7 +137,7 @@ def init_model(
     if checkpoint is not None:
         from .train.checkpoint import load_state_dict
 
-        params, _, loaded_step = load_state_dict(checkpoint, params=params)
+        params, _, _, loaded_step = load_state_dict(checkpoint, params=params)
         if loaded_step is not None:
             logger.info(f"Model checkpoint was restored from {checkpoint}.")
 

@@ -46,6 +46,16 @@ def _str2bool(value: str) -> bool:
     return str(value).strip().lower() in ("1", "true", "yes", "on")
 
 
+def cast_loss_scale(value: str):
+    """'None' -> None, 'dynamic' -> 'dynamic', anything else -> float
+    (mirrors apex's loss_scale flag domain)."""
+    if value == "None":
+        return None
+    if value == "dynamic":
+        return "dynamic"
+    return float(value)
+
+
 def parse_mesh_spec(spec: Optional[str]) -> dict:
     """Parse ``"data:8,model:1"`` / ``"data=8,model=1"`` into an ordered dict."""
     if not spec:
@@ -368,8 +378,11 @@ def get_trainer_parser() -> ConfigArgumentParser:
                         help="Reference-compat alias: O1/O2/O3 -> bf16, O0/None -> f32.")
     parser.add_argument("--apex_verbosity", type=int, default=1,
                         help="Accepted for config compatibility.")
-    parser.add_argument("--apex_loss_scale", type=cast2(float), default=None,
-                        help="Loss scale; bf16 on TPU normally needs none.")
+    parser.add_argument("--apex_loss_scale", type=cast_loss_scale, default=None,
+                        help="Loss scale: a number for static, 'dynamic' for "
+                             "apex-style dynamic scaling (halve on overflow, "
+                             "double after 2000 finite steps, update skipped "
+                             "on overflow). bf16 on TPU normally needs none.")
 
     parser.add_argument("--drop_optimizer", action="store_true",
                         help="Not restore optimizer and scheduler from checkpoint.")

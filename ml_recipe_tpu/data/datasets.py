@@ -298,7 +298,13 @@ class DummyDataset:
         self.max_seq_len = max_seq_len
         self.max_question_len = max_question_len
 
-        self.rng = rng if rng is not None else np.random.default_rng()
+        # Items are derived from (base_seed, index) so content is a pure
+        # function of the index — the reference drew from a shared generator
+        # per access (dummy_dataset.py:20-24), which under a threaded loader
+        # makes item content depend on scheduling (np.random.Generator is
+        # also not thread-safe).
+        seed_rng = rng if rng is not None else np.random.default_rng()
+        self.base_seed = int(seed_rng.integers(2 ** 31))
 
         self.w_ids = (
             [
@@ -321,14 +327,17 @@ class DummyDataset:
             ids[ids == w_id] = self.tokenizer.unk_token_id
         return ids
 
-    def __getitem__(self, *args) -> DatasetItem:
+    def __getitem__(self, index: int = 0) -> DatasetItem:
         document_len = self.max_seq_len - self.max_question_len - 3
 
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.base_seed, int(index)])
+        )
         question_ids = self._delete_special(
-            self.rng.integers(1, len(self.tokenizer), self.max_question_len)
+            rng.integers(1, len(self.tokenizer), self.max_question_len)
         ).tolist()
         document_ids = self._delete_special(
-            self.rng.integers(1, len(self.tokenizer), document_len)
+            rng.integers(1, len(self.tokenizer), document_len)
         ).tolist()
 
         input_ids = (

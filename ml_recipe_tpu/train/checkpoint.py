@@ -35,11 +35,17 @@ def save_state_dict(
     *,
     params,
     opt_state: Any = None,
+    loss_scale: Any = None,
     global_step: int = 0,
     extra: Optional[dict] = None,
     is_primary: bool = True,
 ) -> None:
-    """Write one msgpack checkpoint file (reference trainer.py:355-379)."""
+    """Write one msgpack checkpoint file (reference trainer.py:355-379).
+
+    ``loss_scale`` (the apex-parity scaling state) is stored under its OWN
+    key so checkpoints stay structurally loadable when --apex_loss_scale
+    changes between save and resume.
+    """
     state = {
         "model": serialization.to_state_dict(_to_host(params)),
         "optimizer": (
@@ -52,6 +58,8 @@ def save_state_dict(
         "scheduler": {"last_step": global_step},
         "global_step": global_step,
     }
+    if loss_scale is not None:
+        state["loss_scale"] = serialization.to_state_dict(_to_host(loss_scale))
     if extra:
         state.update(extra)
 
@@ -73,19 +81,21 @@ def load_state_dict(
     *,
     params,
     opt_state: Any = None,
+    loss_scale: Any = None,
     drop_optimizer: bool = False,
 ):
-    """Restore ``(params, opt_state, global_step)`` from a checkpoint.
+    """Restore ``(params, opt_state, loss_scale, global_step)``.
 
-    ``params``/``opt_state`` give the target pytree structure (flax
-    state-dict restoration is structural). Returns the originals when the
-    file does not exist, mirroring the reference's warn-and-continue
-    (trainer.py:381-385).
+    ``params``/``opt_state``/``loss_scale`` give the target pytree structure
+    (flax state-dict restoration is structural). Returns the originals when
+    the file does not exist, mirroring the reference's warn-and-continue
+    (trainer.py:381-385). A ``loss_scale`` target with no saved state (or
+    vice versa) is tolerated: the passed-in value is returned unchanged.
     """
     path = os.fspath(path)
     if not os.path.exists(path):
         logger.warning(f"Checkpoint {path} does not exist, so checkpoint was not loaded.")
-        return params, opt_state, None
+        return params, opt_state, loss_scale, None
 
     with open(path, "rb") as fh:
         state = serialization.msgpack_restore(fh.read())
@@ -99,4 +109,14 @@ def load_state_dict(
         new_opt_state = serialization.from_state_dict(opt_state, state["optimizer"])
         logger.info(f"Optimizer and scheduler also were restored from {path} checkpoint.")
 
-    return new_params, new_opt_state, global_step
+    new_loss_scale = loss_scale
+    if (
+        not drop_optimizer
+        and loss_scale is not None
+        and state.get("loss_scale") is not None
+    ):
+        new_loss_scale = serialization.from_state_dict(
+            loss_scale, state["loss_scale"]
+        )
+
+    return new_params, new_opt_state, new_loss_scale, global_step

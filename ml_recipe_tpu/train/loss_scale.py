@@ -1,0 +1,87 @@
+"""Loss scaling (apex AMP parity).
+
+The reference relies on NVIDIA apex's O1 mixed precision with loss scaling
+(trainer.py:128-133,200-202; flag ``apex_loss_scale`` parser.py:150-153). On
+TPU the bf16 compute dtype needs no scaling — bf16 shares fp32's exponent
+range — so this exists for PARITY and for users who explicitly request it:
+
+- static scale (``--apex_loss_scale 128``): loss is multiplied by S inside
+  the jitted step and gradients unscaled by 1/S;
+- dynamic scale (``--apex_loss_scale dynamic``): apex-style doubling every
+  ``growth_interval`` consecutive finite steps, halving (and SKIPPING the
+  optimizer update) on overflow — all inside the compiled step via
+  ``lax.cond``-free masking, so no host round-trip.
+
+All state lives in a tiny pytree threaded through the train step.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    scale: jnp.ndarray          # f32 scalar, current multiplier
+    growth_count: jnp.ndarray   # i32 scalar, consecutive finite steps
+    dynamic: jnp.ndarray        # bool scalar (static scales never adjust)
+
+
+def init_state(scale: float, *, dynamic: bool) -> LossScaleState:
+    return LossScaleState(
+        scale=jnp.float32(scale),
+        growth_count=jnp.int32(0),
+        dynamic=jnp.asarray(dynamic),
+    )
+
+
+def scale_loss(loss, state: LossScaleState):
+    return loss * state.scale.astype(loss.dtype)
+
+
+def unscale(grads, state: LossScaleState):
+    inv = 1.0 / state.scale
+    return jax.tree_util.tree_map(lambda g: g * inv.astype(g.dtype), grads)
+
+
+def all_finite(grads) -> jnp.ndarray:
+    leaves = [jnp.isfinite(g).all() for g in jax.tree_util.tree_leaves(grads)]
+    return jnp.stack(leaves).all() if leaves else jnp.asarray(True)
+
+
+def update_state(
+    state: LossScaleState,
+    finite: jnp.ndarray,
+    *,
+    growth_interval: int = 2000,
+    growth_factor: float = 2.0,
+    backoff_factor: float = 0.5,
+    max_scale: float = 2.0 ** 16,
+) -> LossScaleState:
+    """Apex-style schedule: halve on overflow, double after
+    ``growth_interval`` consecutive finite steps. No-op for static scales."""
+    grew = state.growth_count + 1 >= growth_interval
+    new_scale = jnp.where(
+        finite,
+        jnp.where(
+            grew, jnp.minimum(state.scale * growth_factor, max_scale), state.scale
+        ),
+        state.scale * backoff_factor,
+    )
+    new_count = jnp.where(finite & ~grew, state.growth_count + 1, jnp.int32(0))
+    return LossScaleState(
+        scale=jnp.where(state.dynamic, new_scale, state.scale),
+        growth_count=jnp.where(state.dynamic, new_count, jnp.int32(0)),
+        dynamic=state.dynamic,
+    )
+
+
+def masked_update(new_tree, old_tree, apply: jnp.ndarray):
+    """Elementwise select: the new value on finite steps, the old one on
+    overflow steps (the apex 'skip the optimizer step' behaviour, without
+    data-dependent control flow inside jit)."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(apply, n, o), new_tree, old_tree
+    )

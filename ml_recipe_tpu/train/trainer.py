@@ -188,6 +188,7 @@ class Trainer:
         self.opt_state = None
         self.scheduler = None
         self._zero_shardings = None
+        self._use_loss_scale = False
         if self.train_dataloader is not None and self.trainer_params is not None:
             micro_batch = self.train_batch_size // self.batch_split
             data_size = int(
@@ -215,7 +216,40 @@ class Trainer:
                 max_grad_norm=self.max_grad_norm,
                 warmup_coef=self.warmup_coef,
             )
+            if getattr(self.trainer_params, "sync_bn", False):
+                # Reference converts BatchNorm -> SyncBN (trainer.py:89-95).
+                # Under GSPMD there is nothing to convert: normalization
+                # statistics computed over the global (data-sharded) batch
+                # are cross-replica by construction — XLA inserts the
+                # collective; LayerNorm (BERT) is per-token and needs none.
+                logger.info(
+                    "sync_bn: cross-replica statistics are inherent under "
+                    "GSPMD (global-batch reductions); nothing to convert."
+                )
+
             self.init_opt_state()
+
+            # apex-parity loss scaling (trainer.py:128-133,200-202): 'dynamic'
+            # or a static scale; None (the TPU-native default) disables it —
+            # bf16 shares fp32's exponent range and needs no scaling.
+            raw_scale = getattr(self.trainer_params, "apex_loss_scale", None)
+            if raw_scale not in (None, "None"):
+                from . import loss_scale as ls
+
+                dynamic = raw_scale == "dynamic"
+                init_scale = 2.0 ** 15 if dynamic else float(raw_scale)
+                self._use_loss_scale = True
+                ls_state = ls.init_state(init_scale, dynamic=dynamic)
+                if not is_single_device(self.mesh):
+                    replicated = NamedSharding(self.mesh, P())
+                    ls_state = jax.tree_util.tree_map(
+                        lambda x: jax.device_put(x, replicated), ls_state
+                    )
+                self.opt_state = (self.opt_state, ls_state)
+                logger.info(
+                    f"Loss scaling enabled: "
+                    f"{'dynamic' if dynamic else init_scale}."
+                )
 
         self.global_step = 0
         self.writer = init_writer(self.is_primary, self.writer_dir)
@@ -226,28 +260,43 @@ class Trainer:
     def init_opt_state(self):
         """(Re)initialize ``opt_state`` from ``self.optimizer``, honoring
         ``shard_optimizer`` (ZeRO-1). Also used by callers that build the
-        optimizer themselves (bench, dry-run)."""
-        if (
+        optimizer themselves (bench, dry-run).
+
+        Placement is always EXPLICIT on multi-device meshes:
+        ``optimizer.init`` reads only param shapes, so XLA prunes the param
+        arguments and without ``out_shardings`` every leaf (scalars like
+        ``count`` included) would land committed to the default device.
+        """
+        use_zero = (
             self.shard_optimizer
             and not is_single_device(self.mesh)
             and int(self.mesh.shape.get("data", 1)) > 1
-        ):
-            from ..parallel.sharding import zero_pspecs
-
-            state_shapes = jax.eval_shape(self.optimizer.init, self.params)
-            self._zero_shardings = jax.tree_util.tree_map(
-                lambda spec: NamedSharding(self.mesh, spec),
-                zero_pspecs(state_shapes, self.mesh, min_size=self.zero_min_size),
-            )
-            self.opt_state = jax.jit(
-                self.optimizer.init, out_shardings=self._zero_shardings
-            )(self.params)
-            logger.info("ZeRO-1: optimizer state sharded over the data axis.")
-        else:
-            # jit so opt-state leaves inherit the param shardings (GSPMD
-            # propagation) instead of landing unsharded on device 0.
+        )
+        if is_single_device(self.mesh):
             self._zero_shardings = None
             self.opt_state = jax.jit(self.optimizer.init)(self.params)
+            return
+
+        import math
+
+        from ..parallel.sharding import zero_pspecs
+
+        state_shapes = jax.eval_shape(self.optimizer.init, self.params)
+        shardings = jax.tree_util.tree_map(
+            lambda spec: NamedSharding(self.mesh, spec),
+            zero_pspecs(
+                state_shapes, self.mesh,
+                # min_size=inf disables the data axis: TP rules still apply,
+                # everything else replicates (the non-ZeRO layout)
+                min_size=self.zero_min_size if use_zero else math.inf,
+            ),
+        )
+        self._zero_shardings = shardings if use_zero else None
+        self.opt_state = jax.jit(
+            self.optimizer.init, out_shardings=shardings
+        )(self.params)
+        if use_zero:
+            logger.info("ZeRO-1: optimizer state sharded over the data axis.")
 
     # -- batch placement ------------------------------------------------------
 
@@ -281,8 +330,11 @@ class Trainer:
         model, loss, optimizer = self.model, self.loss, self.optimizer
         batch_split = self.batch_split
         schedule = self.scheduler
+        use_ls = self._use_loss_scale
 
         def train_step(params, opt_state, inputs, labels, step):
+            if use_ls:
+                opt_state, ls_state = opt_state
             # Per-step dropout keys: pure function of (seed, step, micro-index).
             base = jax.random.fold_in(
                 jax.random.key(self.seed, impl=self.prng_impl), step
@@ -295,6 +347,11 @@ class Trainer:
                     rngs={"dropout": key},
                 )
                 total, values = loss(preds, micro_lab)
+                if use_ls:
+                    from . import loss_scale as ls
+
+                    # scale inside the grad; reported `values` stay unscaled
+                    return ls.scale_loss(total, ls_state), values
                 return total, values
 
             grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
@@ -321,6 +378,17 @@ class Trainer:
             grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
             values = jax.tree_util.tree_map(lambda v: v * inv, values)
 
+            if use_ls:
+                from . import loss_scale as ls
+
+                grads = ls.unscale(grads, ls_state)
+                finite = ls.all_finite(grads)
+                # overflow steps contribute zero grads so optimizer moments
+                # stay untouched (masked below) and the update is a no-op
+                grads = jax.tree_util.tree_map(
+                    lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads
+                )
+
             updates, new_opt_state = optimizer.update(grads, opt_state, params)
             if self._zero_shardings is not None:
                 # keep the ZeRO layout stable across steps: without the
@@ -334,8 +402,33 @@ class Trainer:
             )
 
             # lr APPLIED this step: optax scale_by_schedule reads
-            # schedule(count) pre-increment, i.e. schedule(step).
-            values["lr"] = schedule(step) if schedule is not None else jnp.float32(0)
+            # schedule(count) pre-increment. Without loss scaling count ==
+            # step; with it, overflow steps are skipped (count freezes), so
+            # read the actual count out of the incoming optimizer state.
+            if schedule is None:
+                values["lr"] = jnp.float32(0)
+            elif use_ls:
+                counts = [
+                    leaf
+                    for path, leaf in jax.tree_util.tree_flatten_with_path(opt_state)[0]
+                    if path and getattr(path[-1], "name", None) == "count"
+                ]
+                values["lr"] = schedule(counts[0] if counts else step)
+            else:
+                values["lr"] = schedule(step)
+
+            if use_ls:
+                from . import loss_scale as ls
+
+                # apex semantics: on overflow, skip the whole update (params,
+                # moments, schedule count) and back off the scale
+                new_params = ls.masked_update(new_params, params, finite)
+                new_opt_state = ls.masked_update(new_opt_state, opt_state, finite)
+                ls_state = ls.update_state(ls_state, finite)
+                values["loss_scale"] = ls_state.scale
+                values["grads_finite"] = finite.astype(jnp.float32)
+                return new_params, (new_opt_state, ls_state), values
+
             return new_params, new_opt_state, values
 
         return jax.jit(train_step, donate_argnums=(0, 1))
@@ -531,27 +624,39 @@ class Trainer:
 
     # -- checkpointing (trainer.py:355-403) ------------------------------------
 
+    def _split_ls(self):
+        """Live ``(opt_state, ls_state)``; ls_state is None when scaling is off."""
+        if self._use_loss_scale and isinstance(self.opt_state, tuple):
+            return self.opt_state
+        return self.opt_state, None
+
     def save_state_dict(self, path_):
         if self.debug:
             logger.info(f"Model was not saved to {path_} because of debug mode.")
             return
+        opt_state, ls_state = self._split_ls()
         _save_ckpt(
             path_,
             params=self.params,
-            opt_state=self.opt_state,
+            opt_state=opt_state,
+            loss_scale=ls_state,
             global_step=self.global_step,
             is_primary=self.is_primary,
         )
 
     def load_state_dict(self, path_):
-        params, opt_state, global_step = _load_ckpt(
+        live_opt, live_ls = self._split_ls()
+        params, opt_state, ls_state, global_step = _load_ckpt(
             path_,
             params=self.params,
-            opt_state=self.opt_state,
+            opt_state=live_opt,
+            loss_scale=live_ls,
             drop_optimizer=self.drop_optimizer,
         )
         if global_step is None:
             return
+        if live_ls is not None:
+            opt_state = (opt_state, ls_state)
         # re-place restored host values with the original shardings
         if self._param_shardings is None:
             self.params = shard_params(params, self.mesh)

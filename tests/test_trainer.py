@@ -286,3 +286,113 @@ def test_zero_checkpoint_roundtrip(tmp_path):
     ):
         if hasattr(l1, "sharding"):
             assert l1.sharding.shard_shape(l1.shape) == l2.sharding.shard_shape(l2.shape)
+
+
+def test_loss_scale_unit():
+    from ml_recipe_tpu.train import loss_scale as ls
+
+    st = ls.init_state(1024.0, dynamic=True)
+    # overflow halves
+    st2 = ls.update_state(st, jnp.asarray(False))
+    assert float(st2.scale) == 512.0 and int(st2.growth_count) == 0
+    # growth_interval consecutive finite steps double
+    st3 = ls.init_state(1024.0, dynamic=True)
+    for _ in range(2000):
+        st3 = ls.update_state(st3, jnp.asarray(True))
+    assert float(st3.scale) == 2048.0
+    # static never adjusts
+    st4 = ls.init_state(128.0, dynamic=False)
+    assert float(ls.update_state(st4, jnp.asarray(False)).scale) == 128.0
+
+    # masked_update keeps old values on overflow
+    new = {"a": jnp.ones(3)}
+    old = {"a": jnp.zeros(3)}
+    kept = ls.masked_update(new, old, jnp.asarray(False))
+    np.testing.assert_array_equal(np.asarray(kept["a"]), 0.0)
+
+
+def test_static_loss_scale_matches_unscaled_trajectory(tmp_path):
+    """Scaling the loss by S and unscaling grads by 1/S must not change the
+    optimizer trajectory (f32 grads, no overflow at these magnitudes)."""
+
+    class TPS(TP):
+        apex_loss_scale = 128.0
+
+    t_ref, _ = _make_trainer(tmp_path, dropout=0.0)
+    t_s, _ = _make_trainer(tmp_path, dropout=0.0)
+    t_s = Trainer(
+        model=t_s.model, params=t_s.params, loss=t_s.loss,
+        collate_fun=t_s.collate_fun, trainer_params=TPS(),
+        train_dataset=t_s.train_dataset, test_dataset=t_s.test_dataset,
+        mesh=t_s.mesh, n_epochs=1, train_batch_size=16, test_batch_size=8,
+        batch_split=1, n_jobs=2, warmup_coef=TP.warmup_coef,
+        max_grad_norm=1.0, seed=0,
+    )
+    assert isinstance(t_s.opt_state, tuple)  # (opt_state, ls_state) bundle
+
+    t_ref.train()
+    t_s.train()
+
+    a = jax.tree_util.tree_leaves(_param_snapshot(t_ref.params))
+    b = jax.tree_util.tree_leaves(_param_snapshot(t_s.params))
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(x, y, rtol=2e-4, atol=2e-5)
+
+
+def test_global_batch_stats_are_cross_replica(tmp_path):
+    """The sync_bn parity claim: a batch-mean computed under jit on a
+    data-sharded global array equals the mean over the FULL global batch."""
+    from ml_recipe_tpu.parallel import build_mesh
+    from ml_recipe_tpu.parallel.sharding import make_global_array
+
+    mesh = build_mesh("data:8")
+    x = np.random.default_rng(0).normal(size=(32, 6)).astype(np.float32)
+    with mesh:
+        gx = make_global_array({"x": x}, mesh)["x"]
+        mean = jax.jit(lambda a: a.mean(axis=0))(gx)
+    np.testing.assert_allclose(np.asarray(mean), x.mean(axis=0), rtol=1e-6)
+
+
+def test_loss_scale_checkpoint_compatible_across_flag_change(tmp_path):
+    """A checkpoint saved WITHOUT loss scaling must load into a run WITH it
+    (and vice versa): ls state lives under its own checkpoint key."""
+
+    class TPS(TP):
+        apex_loss_scale = "dynamic"
+
+    def make(tp_cls, sub):
+        t, _ = _make_trainer(tmp_path, dropout=0.0)
+        return Trainer(
+            model=t.model, params=t.params, loss=t.loss,
+            collate_fun=t.collate_fun, trainer_params=tp_cls(),
+            train_dataset=t.train_dataset, test_dataset=t.test_dataset,
+            mesh=t.mesh, n_epochs=1, train_batch_size=16, test_batch_size=8,
+            batch_split=1, n_jobs=2, warmup_coef=TP.warmup_coef,
+            max_grad_norm=1.0, seed=0,
+        )
+
+    plain = make(TP, "a")  # sub tags kept for readability only
+    plain.train()
+    ck_plain = tmp_path / "plain.ch"
+    plain.save_state_dict(ck_plain)
+
+    scaled = make(TPS, "b")
+    scaled.load_state_dict(ck_plain)  # plain ckpt -> scaled run: ls kept fresh
+    assert scaled.global_step == plain.global_step
+    _, ls = scaled._split_ls()
+    assert ls is not None and float(ls.scale) == 2.0 ** 15
+
+    scaled.train()
+    ck_scaled = tmp_path / "scaled.ch"
+    scaled.save_state_dict(ck_scaled)
+
+    plain2 = make(TP, "c")
+    plain2.load_state_dict(ck_scaled)  # scaled ckpt -> plain run: ls ignored
+    assert plain2.global_step == scaled.global_step
+
+    scaled2 = make(TPS, "d")
+    scaled2.load_state_dict(ck_scaled)  # scaled -> scaled: ls restored
+    _, ls2 = scaled2._split_ls()
+    # growth_count counts only the steps trained UNDER scaling (the ls state
+    # was fresh when the plain checkpoint was loaded)
+    assert int(ls2.growth_count) == scaled.global_step - plain.global_step
