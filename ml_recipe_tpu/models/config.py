@@ -39,6 +39,13 @@ class EncoderConfig:
 
 
 MODEL_PRESETS = {
+    # google/bert_uncased_L-2_H-128_A-2 dims — CI smoke runs and CPU-mesh
+    # integration tests; shares the full bert vocab so any bert tokenizer ids
+    # stay in range
+    "bert-tiny": EncoderConfig(
+        model_type="bert", vocab_size=30522, hidden_size=128, num_layers=2,
+        num_heads=2, intermediate_size=512,
+    ),
     "bert-base-uncased": EncoderConfig(
         model_type="bert", vocab_size=30522, hidden_size=768, num_layers=12,
         num_heads=12, intermediate_size=3072,

@@ -29,6 +29,15 @@ class LossScaleState(NamedTuple):
     dynamic: jnp.ndarray        # bool scalar (static scales never adjust)
 
 
+class OptStateWithLS(NamedTuple):
+    """Optimizer state bundled with the scaling state. A dedicated type (not
+    a bare 2-tuple): optax chain states are themselves tuples, so a bare
+    bundle would be structurally ambiguous to unpackers."""
+
+    inner: object
+    ls: LossScaleState
+
+
 def init_state(scale: float, *, dynamic: bool) -> LossScaleState:
     return LossScaleState(
         scale=jnp.float32(scale),
@@ -59,8 +68,11 @@ def update_state(
     growth_factor: float = 2.0,
     backoff_factor: float = 0.5,
     max_scale: float = 2.0 ** 16,
+    min_scale: float = 2.0 ** -14,
 ) -> LossScaleState:
-    """Apex-style schedule: halve on overflow, double after
+    """Apex-style schedule: halve on overflow (floored at ``min_scale`` so a
+    sustained non-finite burst can never underflow the scale to 0, which
+    would make ``unscale`` produce inf forever), double after
     ``growth_interval`` consecutive finite steps. No-op for static scales."""
     grew = state.growth_count + 1 >= growth_interval
     new_scale = jnp.where(
@@ -68,7 +80,7 @@ def update_state(
         jnp.where(
             grew, jnp.minimum(state.scale * growth_factor, max_scale), state.scale
         ),
-        state.scale * backoff_factor,
+        jnp.maximum(state.scale * backoff_factor, min_scale),
     )
     new_count = jnp.where(finite & ~grew, state.growth_count + 1, jnp.int32(0))
     return LossScaleState(

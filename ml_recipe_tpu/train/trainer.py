@@ -43,6 +43,7 @@ from ..metrics import AverageMeter
 from ..parallel import build_mesh, gather_to_host, make_global_array, shard_params
 from ..parallel.sharding import is_single_device
 from ..utils.profiler import time_profiler
+from . import loss_scale as ls_lib
 from .callback import TestCallback
 from .checkpoint import load_state_dict as _load_ckpt
 from .checkpoint import save_state_dict as _save_ckpt
@@ -227,29 +228,28 @@ class Trainer:
                     "GSPMD (global-batch reductions); nothing to convert."
                 )
 
-            self.init_opt_state()
-
             # apex-parity loss scaling (trainer.py:128-133,200-202): 'dynamic'
             # or a static scale; None (the TPU-native default) disables it —
             # bf16 shares fp32's exponent range and needs no scaling.
             raw_scale = getattr(self.trainer_params, "apex_loss_scale", None)
             if raw_scale not in (None, "None"):
-                from . import loss_scale as ls
-
-                dynamic = raw_scale == "dynamic"
-                init_scale = 2.0 ** 15 if dynamic else float(raw_scale)
                 self._use_loss_scale = True
-                ls_state = ls.init_state(init_scale, dynamic=dynamic)
-                if not is_single_device(self.mesh):
-                    replicated = NamedSharding(self.mesh, P())
-                    ls_state = jax.tree_util.tree_map(
-                        lambda x: jax.device_put(x, replicated), ls_state
+                self._ls_dynamic = raw_scale == "dynamic"
+                if not self._ls_dynamic and float(raw_scale) <= 0:
+                    raise ValueError(
+                        f"apex_loss_scale must be positive or 'dynamic', got "
+                        f"{raw_scale!r} (0 would zero every loss and NaN the "
+                        f"unscaled grads)."
                     )
-                self.opt_state = (self.opt_state, ls_state)
+                self._ls_init_scale = (
+                    2.0 ** 15 if self._ls_dynamic else float(raw_scale)
+                )
                 logger.info(
                     f"Loss scaling enabled: "
-                    f"{'dynamic' if dynamic else init_scale}."
+                    f"{'dynamic' if self._ls_dynamic else self._ls_init_scale}."
                 )
+
+            self.init_opt_state()
 
         self.global_step = 0
         self.writer = init_writer(self.is_primary, self.writer_dir)
@@ -275,6 +275,7 @@ class Trainer:
         if is_single_device(self.mesh):
             self._zero_shardings = None
             self.opt_state = jax.jit(self.optimizer.init)(self.params)
+            self._bundle_ls()
             return
 
         import math
@@ -297,6 +298,7 @@ class Trainer:
         )(self.params)
         if use_zero:
             logger.info("ZeRO-1: optimizer state sharded over the data axis.")
+        self._bundle_ls()
 
     # -- batch placement ------------------------------------------------------
 
@@ -334,7 +336,7 @@ class Trainer:
 
         def train_step(params, opt_state, inputs, labels, step):
             if use_ls:
-                opt_state, ls_state = opt_state
+                opt_state, ls_state = opt_state.inner, opt_state.ls
             # Per-step dropout keys: pure function of (seed, step, micro-index).
             base = jax.random.fold_in(
                 jax.random.key(self.seed, impl=self.prng_impl), step
@@ -348,10 +350,8 @@ class Trainer:
                 )
                 total, values = loss(preds, micro_lab)
                 if use_ls:
-                    from . import loss_scale as ls
-
                     # scale inside the grad; reported `values` stay unscaled
-                    return ls.scale_loss(total, ls_state), values
+                    return ls_lib.scale_loss(total, ls_state), values
                 return total, values
 
             grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
@@ -379,10 +379,8 @@ class Trainer:
             values = jax.tree_util.tree_map(lambda v: v * inv, values)
 
             if use_ls:
-                from . import loss_scale as ls
-
-                grads = ls.unscale(grads, ls_state)
-                finite = ls.all_finite(grads)
+                grads = ls_lib.unscale(grads, ls_state)
+                finite = ls_lib.all_finite(grads)
                 # overflow steps contribute zero grads so optimizer moments
                 # stay untouched (masked below) and the update is a no-op
                 grads = jax.tree_util.tree_map(
@@ -418,16 +416,16 @@ class Trainer:
                 values["lr"] = schedule(step)
 
             if use_ls:
-                from . import loss_scale as ls
-
                 # apex semantics: on overflow, skip the whole update (params,
                 # moments, schedule count) and back off the scale
-                new_params = ls.masked_update(new_params, params, finite)
-                new_opt_state = ls.masked_update(new_opt_state, opt_state, finite)
-                ls_state = ls.update_state(ls_state, finite)
+                new_params = ls_lib.masked_update(new_params, params, finite)
+                new_opt_state = ls_lib.masked_update(new_opt_state, opt_state, finite)
+                ls_state = ls_lib.update_state(ls_state, finite)
                 values["loss_scale"] = ls_state.scale
                 values["grads_finite"] = finite.astype(jnp.float32)
-                return new_params, (new_opt_state, ls_state), values
+                return new_params, ls_lib.OptStateWithLS(
+                    new_opt_state, ls_state
+                ), values
 
             return new_params, new_opt_state, values
 
@@ -624,10 +622,23 @@ class Trainer:
 
     # -- checkpointing (trainer.py:355-403) ------------------------------------
 
+    def _bundle_ls(self):
+        """Wrap a freshly initialized ``opt_state`` with a fresh scaling
+        state when loss scaling is on (no-op otherwise)."""
+        if not self._use_loss_scale:
+            return
+        ls_state = ls_lib.init_state(self._ls_init_scale, dynamic=self._ls_dynamic)
+        if not is_single_device(self.mesh):
+            replicated = NamedSharding(self.mesh, P())
+            ls_state = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, replicated), ls_state
+            )
+        self.opt_state = ls_lib.OptStateWithLS(self.opt_state, ls_state)
+
     def _split_ls(self):
         """Live ``(opt_state, ls_state)``; ls_state is None when scaling is off."""
-        if self._use_loss_scale and isinstance(self.opt_state, tuple):
-            return self.opt_state
+        if isinstance(self.opt_state, ls_lib.OptStateWithLS):
+            return self.opt_state.inner, self.opt_state.ls
         return self.opt_state, None
 
     def save_state_dict(self, path_):
@@ -656,7 +667,21 @@ class Trainer:
         if global_step is None:
             return
         if live_ls is not None:
-            opt_state = (opt_state, ls_state)
+            mode_differs = bool(ls_state.dynamic) != bool(live_ls.dynamic)
+            static_value_differs = (
+                not bool(live_ls.dynamic)
+                and float(ls_state.scale) != float(live_ls.scale)
+            )
+            if ls_state is not live_ls and (mode_differs or static_value_differs):
+                # the flag is CONFIG: neither the mode nor a static value may
+                # be silently overridden by what a checkpoint happened to
+                # contain — keep the freshly configured state
+                logger.warning(
+                    "Checkpoint loss-scale state differs from --apex_loss_scale; "
+                    "keeping the configured scaling state."
+                )
+                ls_state = live_ls
+            opt_state = ls_lib.OptStateWithLS(opt_state, ls_state)
         # re-place restored host values with the original shardings
         if self._param_shardings is None:
             self.params = shard_params(params, self.mesh)

@@ -396,3 +396,46 @@ def test_loss_scale_checkpoint_compatible_across_flag_change(tmp_path):
     # growth_count counts only the steps trained UNDER scaling (the ls state
     # was fresh when the plain checkpoint was loaded)
     assert int(ls2.growth_count) == scaled.global_step - plain.global_step
+
+
+def test_loss_scale_min_floor():
+    from ml_recipe_tpu.train import loss_scale as ls
+
+    st = ls.init_state(2.0 ** -13, dynamic=True)
+    for _ in range(10):  # sustained overflow burst
+        st = ls.update_state(st, jnp.asarray(False))
+    assert float(st.scale) == 2.0 ** -14  # floored, never 0
+
+
+def test_loss_scale_mode_mismatch_keeps_configured(tmp_path):
+    """--apex_loss_scale is config: resuming a dynamic checkpoint into a
+    static run must keep the configured static state (and vice versa)."""
+
+    class TPD(TP):
+        apex_loss_scale = "dynamic"
+
+    class TPStatic(TP):
+        apex_loss_scale = 64.0
+
+    def make(tp_cls):
+        t, _ = _make_trainer(tmp_path, dropout=0.0)
+        return Trainer(
+            model=t.model, params=t.params, loss=t.loss,
+            collate_fun=t.collate_fun, trainer_params=tp_cls(),
+            train_dataset=t.train_dataset, test_dataset=t.test_dataset,
+            mesh=t.mesh, n_epochs=1, train_batch_size=16, test_batch_size=8,
+            batch_split=1, n_jobs=2, warmup_coef=TP.warmup_coef,
+            max_grad_norm=1.0, seed=0,
+        )
+
+    dyn = make(TPD)
+    dyn.train()
+    ck = tmp_path / "dyn.ch"
+    dyn.save_state_dict(ck)
+
+    static = make(TPStatic)
+    static.load_state_dict(ck)
+    _, ls = static._split_ls()
+    assert not bool(ls.dynamic)
+    assert float(ls.scale) == 64.0  # configured static value, not the ckpt's
+    assert static.global_step == dyn.global_step  # weights/step still restored
