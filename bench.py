@@ -72,7 +72,7 @@ def main() -> None:
     from ml_recipe_tpu.train.optim import build_optimizer
 
     trainer.optimizer, trainer.scheduler = build_optimizer(
-        TP(), trainer.params, num_training_steps=10_000, max_grad_norm=1.0,
+        TP(), trainer.params, num_training_steps=10_000, max_grad_norm=None,
         warmup_coef=0.0,
     )
     trainer.init_opt_state()

@@ -76,6 +76,23 @@ def save_state_dict(
     logger.info(f"State dict was saved to {path}.")
 
 
+def _strip_legacy_clip_state(node):
+    """Recursively rewrite ``{"0": {}, "1": X, ...}`` chain states (whose
+    leading element was clip_by_global_norm's EmptyState) to drop the empty
+    slot and shift the rest down one key."""
+    if isinstance(node, dict):
+        if (
+            set(node.keys()) >= {"0", "1"}
+            and all(k.isdigit() for k in node.keys())
+            and node["0"] == {}
+        ):
+            node = {
+                str(int(k) - 1): v for k, v in node.items() if k != "0"
+            }
+        return {k: _strip_legacy_clip_state(v) for k, v in node.items()}
+    return node
+
+
 def load_state_dict(
     path,
     *,
@@ -106,7 +123,17 @@ def load_state_dict(
     new_opt_state = opt_state
     global_step = int(state.get("global_step", 0))
     if not drop_optimizer and opt_state is not None and state.get("optimizer") is not None:
-        new_opt_state = serialization.from_state_dict(opt_state, state["optimizer"])
+        try:
+            new_opt_state = serialization.from_state_dict(
+                opt_state, state["optimizer"]
+            )
+        except (ValueError, KeyError):
+            # Legacy layout: clip_by_global_norm used to live in the optax
+            # chain as a leading EmptyState ({"0": {}, "1": core}); clipping
+            # moved into the train step, so strip the empty element and retry.
+            migrated = _strip_legacy_clip_state(state["optimizer"])
+            new_opt_state = serialization.from_state_dict(opt_state, migrated)
+            logger.info("Migrated legacy optimizer state (in-chain clip).")
         logger.info(f"Optimizer and scheduler also were restored from {path} checkpoint.")
 
     new_loss_scale = loss_scale

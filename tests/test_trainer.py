@@ -439,3 +439,35 @@ def test_loss_scale_mode_mismatch_keeps_configured(tmp_path):
     assert not bool(ls.dynamic)
     assert float(ls.scale) == 64.0  # configured static value, not the ckpt's
     assert static.global_step == dyn.global_step  # weights/step still restored
+
+
+def test_legacy_clip_chain_checkpoint_loads(tmp_path):
+    """Checkpoints saved when clip_by_global_norm lived in the optax chain
+    (a leading EmptyState) must still resume after clipping moved into the
+    train step."""
+    from ml_recipe_tpu.train.optim import build_optimizer
+
+    t, _ = _make_trainer(tmp_path, dropout=0.0)
+    t.train()
+
+    # forge a legacy checkpoint: same trained params, optimizer state saved
+    # under the OLD chain structure (clip EmptyState + core)
+    legacy_tx, _ = build_optimizer(
+        TP(), t.params, num_training_steps=4, max_grad_norm=1.0,
+        warmup_coef=TP.warmup_coef,
+    )
+    legacy_state = jax.jit(legacy_tx.init)(t.params)
+    from ml_recipe_tpu.train import checkpoint as ck
+
+    ck.save_state_dict(
+        tmp_path / "legacy.ch", params=t.params, opt_state=legacy_state,
+        global_step=t.global_step, is_primary=True,
+    )
+
+    t2, _ = _make_trainer(tmp_path, dropout=0.0)
+    t2.load_state_dict(tmp_path / "legacy.ch")  # must not raise
+    assert t2.global_step == t.global_step
+    a = jax.tree_util.tree_leaves(_param_snapshot(t.params))
+    b = jax.tree_util.tree_leaves(_param_snapshot(t2.params))
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(x, y, rtol=1e-6)
