@@ -132,8 +132,24 @@ def load_state_dict(
             # chain as a leading EmptyState ({"0": {}, "1": core}); clipping
             # moved into the train step, so strip the empty element and retry.
             migrated = _strip_legacy_clip_state(state["optimizer"])
-            new_opt_state = serialization.from_state_dict(opt_state, migrated)
-            logger.info("Migrated legacy optimizer state (in-chain clip).")
+            try:
+                new_opt_state = serialization.from_state_dict(opt_state, migrated)
+                logger.info("Migrated legacy optimizer state (in-chain clip).")
+            except (ValueError, KeyError):
+                # Legacy fine-tune layout: a bare optax.masked(tx) state; the
+                # chain now appends masked(set_to_zero) for the frozen
+                # complement, so the target is a 2-element chain whose second
+                # slot holds no values — wrap the legacy state as slot "0"
+                # and take slot "1" from the freshly initialized target.
+                target_sd = serialization.to_state_dict(opt_state)
+                if isinstance(target_sd, dict) and set(target_sd.keys()) == {"0", "1"}:
+                    wrapped = {"0": migrated, "1": target_sd["1"]}
+                    new_opt_state = serialization.from_state_dict(opt_state, wrapped)
+                    logger.info(
+                        "Migrated legacy fine-tune optimizer state (masked -> chain)."
+                    )
+                else:
+                    raise
         logger.info(f"Optimizer and scheduler also were restored from {path} checkpoint.")
 
     new_loss_scale = loss_scale

@@ -245,6 +245,14 @@ def build_optimizer(
 
     tmask = trainable_mask(params, trainer_params)
     if tmask is not None:
-        tx = optax.masked(tx, tmask)
+        # optax.masked passes NON-masked updates through UNCHANGED — i.e. the
+        # frozen leaves would come out as their raw gradients and be added to
+        # the params. Chain a set_to_zero over the frozen complement so
+        # frozen modules stay frozen (reference semantics: frozen params are
+        # simply never given to the optimizer, init.py:85-123).
+        frozen = jax.tree_util.tree_map(lambda m: not m, tmask)
+        tx = optax.chain(
+            optax.masked(tx, tmask), optax.masked(optax.set_to_zero(), frozen)
+        )
 
     return tx, schedule

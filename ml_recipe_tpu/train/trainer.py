@@ -47,7 +47,7 @@ from . import loss_scale as ls_lib
 from .callback import TestCallback
 from .checkpoint import load_state_dict as _load_ckpt
 from .checkpoint import save_state_dict as _save_ckpt
-from .optim import build_optimizer
+from .optim import build_optimizer, trainable_mask
 from .writer import init_writer
 
 logger = logging.getLogger(__name__)
@@ -340,6 +340,26 @@ class Trainer:
         # clips the flat gradient vector itself whenever max_grad_norm is set
         clip_norm = self.max_grad_norm
 
+        # Fine-tune freezing: gradients of non-trainable modules are zeroed
+        # before the finite-check / clip / optimizer, so (a) the global clip
+        # norm measures trainable gradients only (torch clip_grad_norm_ over
+        # the optimized params, reference trainer.py:221-225) and (b) the
+        # optax.masked passthrough leaves get a zero update.
+        tmask = (
+            trainable_mask(self.params, self.trainer_params)
+            if self.trainer_params is not None
+            else None
+        )
+        # The flat f32 gradient carry is replicated; on a pure data-parallel
+        # mesh grads are replicated anyway so it only fuses launches, but on
+        # a model(TP)-axis mesh it would all-gather every sharded gradient
+        # each micro-batch — use sharding-preserving per-tensor accumulation
+        # there instead.
+        use_flat = (
+            is_single_device(self.mesh)
+            or int(self.mesh.shape.get("model", 1)) <= 1
+        )
+
         def train_step(params, opt_state, inputs, labels, step):
             if use_ls:
                 opt_state, ls_state = opt_state.inner, opt_state.ls
@@ -362,14 +382,19 @@ class Trainer:
 
             grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
-            # Gradients accumulate as ONE flat f32 vector: a per-tensor
-            # tree_map add in the scan carry costs ~2 kernel launches per
-            # parameter tensor per micro-batch (measured 28% of the bert-base
-            # step on v5e — launch-bound, the actual traffic is ~7ms); a
-            # single fused add + one carry buffer removes it.
+            # Gradients accumulate in f32. On data-only meshes they live as
+            # ONE flat vector: a per-tensor tree_map add in the scan carry
+            # costs ~2 kernel launches per parameter tensor per micro-batch
+            # (measured 28% of the bert-base step on v5e — launch-bound, the
+            # actual traffic is ~7ms); a single fused add + one carry buffer
+            # removes it. On TP meshes the per-tensor path keeps each
+            # gradient in its parameter's sharding.
             leaves, treedef = jax.tree_util.tree_flatten(params)
             sizes = [int(np.prod(l.shape)) if l.ndim else 1 for l in leaves]
             offsets = np.cumsum([0] + sizes)
+            mask_leaves = (
+                jax.tree_util.tree_leaves(tmask) if tmask is not None else None
+            )
 
             def flatten_grads(tree):
                 return jnp.concatenate(
@@ -390,46 +415,102 @@ class Trainer:
                     ],
                 )
 
+            def acc_init():
+                if use_flat:
+                    return jnp.zeros((int(offsets[-1]),), jnp.float32)
+                return jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params
+                )
+
+            def acc_add(acc, grads):
+                if use_flat:
+                    return acc + flatten_grads(grads)
+                return jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32), acc, grads
+                )
+
             def micro_step(carry, xs):
                 g_acc, v_acc = carry
                 micro_in, micro_lab, key = xs
                 (_, values), grads = grad_fn(params, micro_in, micro_lab, key)
-                g_acc = g_acc + flatten_grads(grads)
+                g_acc = acc_add(g_acc, grads)
                 v_acc = jax.tree_util.tree_map(jnp.add, v_acc, values)
                 return (g_acc, v_acc), None
 
-            g0 = jnp.zeros((int(offsets[-1]),), jnp.float32)
             # values structure: probe with a zero-cost eval_shape-compatible init
             v0 = jax.tree_util.tree_map(
                 lambda _: jnp.zeros((), jnp.float32),
                 loss.value_structure(),
             )
 
-            (flat_grads, values), _ = jax.lax.scan(
-                micro_step, (g0, v0), (inputs, labels, keys)
+            (acc_grads, values), _ = jax.lax.scan(
+                micro_step, (acc_init(), v0), (inputs, labels, keys)
             )
             inv = 1.0 / batch_split
-            flat_grads = flat_grads * inv
             values = jax.tree_util.tree_map(lambda v: v * inv, values)
 
-            # Loss-scale unscale/finite-check and global-norm clipping run in
-            # the FLAT domain: one fused kernel each, versus ~2 launches per
-            # parameter tensor for tree-wise ops (the optimizer chain is
-            # built without clip_by_global_norm; semantics identical).
-            if use_ls:
-                flat_grads = ls_lib.unscale(flat_grads, ls_state)
-                finite = ls_lib.all_finite(flat_grads)
-                # overflow steps contribute zero grads so optimizer moments
-                # stay untouched (masked below) and the update is a no-op
-                flat_grads = jnp.where(finite, flat_grads, 0.0)
-            if clip_norm is not None and clip_norm > 0:
-                # optax.clip_by_global_norm semantics: g * c / max(norm, c)
-                gnorm = jnp.sqrt(jnp.sum(flat_grads * flat_grads))
-                flat_grads = flat_grads * (
-                    clip_norm / jnp.maximum(gnorm, clip_norm)
+            # Loss-scale unscale/finite-check and global-norm clipping run
+            # over the accumulated f32 gradients — in the flat domain that is
+            # one fused kernel each, versus ~2 launches per parameter tensor
+            # for tree-wise ops (the optimizer chain is built without
+            # clip_by_global_norm; semantics identical to torch
+            # clip_grad_norm_ over the OPTIMIZED params: frozen modules are
+            # zeroed first so they contribute nothing to the norm).
+            if use_flat:
+                flat_grads = acc_grads * inv
+                if mask_leaves is not None:
+                    # where, not multiply: a frozen module's inf/nan gradient
+                    # must vanish (inf * 0 = nan would poison the clip norm /
+                    # trip the loss-scale finite check for params that are
+                    # not even optimized)
+                    mask_vec = jnp.concatenate(
+                        [
+                            jnp.full((sizes[i],), bool(mask_leaves[i]))
+                            for i in range(len(leaves))
+                        ]
+                    )
+                    flat_grads = jnp.where(mask_vec, flat_grads, 0.0)
+                if use_ls:
+                    flat_grads = ls_lib.unscale(flat_grads, ls_state)
+                    finite = ls_lib.all_finite(flat_grads)
+                    # overflow steps contribute zero grads so optimizer
+                    # moments stay untouched (masked below) and the update
+                    # is a no-op
+                    flat_grads = jnp.where(finite, flat_grads, 0.0)
+                if clip_norm is not None and clip_norm > 0:
+                    # optax.clip_by_global_norm semantics: g * c / max(norm, c)
+                    gnorm = jnp.sqrt(jnp.sum(flat_grads * flat_grads))
+                    flat_grads = flat_grads * (
+                        clip_norm / jnp.maximum(gnorm, clip_norm)
+                    )
+                grads = unflatten_grads(flat_grads)
+            else:
+                grads = jax.tree_util.tree_map(lambda g: g * inv, acc_grads)
+                if tmask is not None:
+                    # static zeroing (mask is known at trace time): frozen
+                    # leaves become literal zeros, so non-finite frozen grads
+                    # can't leak into the norm or the finite check
+                    grads = jax.tree_util.tree_map(
+                        lambda g, m: g if m else jnp.zeros_like(g), grads, tmask
+                    )
+                if use_ls:
+                    grads = ls_lib.unscale(grads, ls_state)
+                    finite = ls_lib.all_finite(grads)
+                    grads = jax.tree_util.tree_map(
+                        lambda g: jnp.where(finite, g, 0.0), grads
+                    )
+                if clip_norm is not None and clip_norm > 0:
+                    gnorm = jnp.sqrt(
+                        sum(
+                            jnp.sum(g * g)
+                            for g in jax.tree_util.tree_leaves(grads)
+                        )
+                    )
+                    scale = clip_norm / jnp.maximum(gnorm, clip_norm)
+                    grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+                grads = jax.tree_util.tree_map(
+                    lambda g, p: g.astype(p.dtype), grads, params
                 )
-
-            grads = unflatten_grads(flat_grads)
 
             updates, new_opt_state = optimizer.update(grads, opt_state, params)
             if self._zero_shardings is not None:
