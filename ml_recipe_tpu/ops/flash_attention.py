@@ -41,9 +41,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
-# Fully-fused fwd+bwd VMEM budget: ~5 f32 [L, L] temporaries (scores, probs,
-# keep, dprobs, dscores) + the [L, D] operands. 512 -> ~6 MB, well under the
-# ~16 MB/core VMEM; 1024 would need ~21 MB.
+# Fully-fused fwd+bwd limit: the per-head [L, L] f32 temporaries (scores,
+# probs, keep, dprobs, dscores) must fit VMEM next to the double-buffered
+# [L, hc*D] operand blocks (_pick_head_chunk sizes hc for that). 512 keeps
+# the temporaries ~6 MB; 1024 would need ~21 MB for them alone.
 _FUSED_BWD_MAX_LEN = 512
 
 
@@ -76,91 +77,112 @@ def _softmax_probs(q, k, mask, scale):
 
 
 def _fused_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, o_ref,
-                      *, scale: float, rate: float, heads: int):
-    """One (batch, head) program: softmax(q k^T / sqrt(d)) v with optional
-    attention-probs dropout, fully in VMEM."""
-    q = q_ref[0, 0, :, :]
-    k = k_ref[0, 0, :, :]
-    v = v_ref[0, 0, :, :]
+                      *, scale: float, rate: float, heads: int, hc: int,
+                      D: int):
+    """One (batch, head-group) program: softmax(q k^T / sqrt(d)) v for ``hc``
+    heads, with optional attention-probs dropout, fully in VMEM. Operands
+    arrive FOLDED as [B, L, H*D] — contiguous with the encoder's natural
+    [B, L, H, D] layout, so no relayout transposes surround the custom call
+    (XLA cannot fuse a transpose INTO a custom call; the former [B,H,L,D]
+    kernel layout cost 4 HBM round-trips of q/k/v/o per layer — measured
+    10% of the bert-base train step). Heads are static lane slices of the
+    folded block, looped unrolled; ``hc`` bounds the block so in/out
+    double-buffers + [L, L] f32 temporaries fit VMEM."""
+    b, hj = pl.program_id(0), pl.program_id(1)
     mask = mask_ref[0, 0, :]
+    for h in range(hc):
+        sl = slice(h * D, (h + 1) * D)
+        q = q_ref[0, :, sl]
+        k = k_ref[0, :, sl]
+        v = v_ref[0, :, sl]
 
-    p = _softmax_probs(q, k, mask, scale)
+        p = _softmax_probs(q, k, mask, scale)
 
-    if rate > 0.0:
-        b, h = pl.program_id(0), pl.program_id(1)
-        u = _uniform_grid(seed_ref[0], b * heads + h, q.shape[0])
-        p = jnp.where(u >= rate, p * (1.0 / (1.0 - rate)), 0.0)
+        if rate > 0.0:
+            u = _uniform_grid(seed_ref[0], b * heads + hj * hc + h, q.shape[0])
+            p = jnp.where(u >= rate, p * (1.0 / (1.0 - rate)), 0.0)
 
-    o = jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    o_ref[0, 0, :, :] = o.astype(o_ref.dtype)
+        o = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        o_ref[0, :, sl] = o.astype(o_ref.dtype)
 
 
 def _fused_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
                       dq_ref, dk_ref, dv_ref,
-                      *, scale: float, rate: float, heads: int):
-    """One (batch, head) program: exact attention backward, recomputing the
-    probabilities (and regenerating the identical dropout mask) in VMEM."""
-    q = q_ref[0, 0, :, :]
-    k = k_ref[0, 0, :, :]
-    v = v_ref[0, 0, :, :]
-    g = g_ref[0, 0, :, :]
+                      *, scale: float, rate: float, heads: int, hc: int,
+                      D: int):
+    """One (batch, head-group) program: exact attention backward for ``hc``
+    heads, recomputing the probabilities (and regenerating the identical
+    dropout mask) in VMEM. Folded [B, L, H*D] layout like the forward."""
+    b, hj = pl.program_id(0), pl.program_id(1)
     mask = mask_ref[0, 0, :]
+    for h in range(hc):
+        sl = slice(h * D, (h + 1) * D)
+        q = q_ref[0, :, sl]
+        k = k_ref[0, :, sl]
+        v = v_ref[0, :, sl]
+        g = g_ref[0, :, sl]
 
-    p = _softmax_probs(q, k, mask, scale)  # [L, L] f32, pre-dropout
+        p = _softmax_probs(q, k, mask, scale)  # [L, L] f32, pre-dropout
 
-    if rate > 0.0:
-        b, h = pl.program_id(0), pl.program_id(1)
-        keep = _uniform_grid(seed_ref[0], b * heads + h, q.shape[0]) >= rate
-        inv = jnp.float32(1.0 / (1.0 - rate))
-        p_drop = jnp.where(keep, p * inv, 0.0)
-    else:
-        p_drop = p
+        if rate > 0.0:
+            keep = _uniform_grid(
+                seed_ref[0], b * heads + hj * hc + h, q.shape[0]
+            ) >= rate
+            inv = jnp.float32(1.0 / (1.0 - rate))
+            p_drop = jnp.where(keep, p * inv, 0.0)
+        else:
+            p_drop = p
 
-    # dv = p_drop^T g
-    dv = jax.lax.dot_general(
-        p_drop.astype(g.dtype), g, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    # dp_drop = g v^T
-    dp_drop = jax.lax.dot_general(
-        g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    )
-    # dropout backward, then softmax backward
-    if rate > 0.0:
-        dp = jnp.where(keep, dp_drop * inv, 0.0)
-    else:
-        dp = dp_drop
-    row = jnp.sum(dp * p, axis=-1, keepdims=True)
-    ds = p * (dp - row)  # [L, L] f32; zero on masked keys since p is zero
+        # dv = p_drop^T g
+        dv = jax.lax.dot_general(
+            p_drop.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # dp_drop = g v^T
+        dp_drop = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        # dropout backward, then softmax backward
+        if rate > 0.0:
+            dp = jnp.where(keep, dp_drop * inv, 0.0)
+        else:
+            dp = dp_drop
+        row = jnp.sum(dp * p, axis=-1, keepdims=True)
+        ds = p * (dp - row)  # [L, L] f32; zero on masked keys since p is zero
 
-    dq = jax.lax.dot_general(
-        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale
-    dk = jax.lax.dot_general(
-        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale
+        dq = jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        dk = jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
 
-    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
-    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+        dq_ref[0, :, sl] = dq.astype(dq_ref.dtype)
+        dk_ref[0, :, sl] = dk.astype(dk_ref.dtype)
+        dv_ref[0, :, sl] = dv.astype(dv_ref.dtype)
 
 
-def _blocked_fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref, *, scale: float):
-    """One (batch, head, q-block) program for longer sequences (no dropout)."""
-    q = q_ref[0, 0, :, :]
-    k = k_ref[0, 0, :, :]
-    v = v_ref[0, 0, :, :]
-    p = _softmax_probs(q, k, mask_ref[0, 0, :], scale)
-    o = jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    o_ref[0, 0, :, :] = o.astype(o_ref.dtype)
+def _blocked_fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref,
+                        *, scale: float, hc: int, D: int):
+    """One (batch, q-block, head-group) program for longer sequences
+    (no dropout)."""
+    mask = mask_ref[0, 0, :]
+    for h in range(hc):
+        sl = slice(h * D, (h + 1) * D)
+        q = q_ref[0, :, sl]
+        k = k_ref[0, :, sl]
+        v = v_ref[0, :, sl]
+        p = _softmax_probs(q, k, mask, scale)
+        o = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        o_ref[0, :, sl] = o.astype(o_ref.dtype)
 
 
 def _pick_q_block(L: int) -> Optional[int]:
@@ -177,73 +199,110 @@ def supports_fused_bwd(L: int) -> bool:
     return L <= _FUSED_BWD_MAX_LEN and _pick_q_block(L) is not None
 
 
-def _bhld(x):
-    return jnp.transpose(x, (0, 2, 1, 3))
+def _fold(x):
+    """[B, L, H, D] -> [B, L, H*D]: contiguous, so XLA lowers it to a free
+    bitcast (unlike the [B,H,L,D] relayout, which is a real HBM copy)."""
+    B, L, H, D = x.shape
+    return x.reshape(B, L, H * D)
+
+
+_VMEM_BUDGET = 12 * 1024 * 1024  # leave ~4 MB of the ~16 MB/core for Mosaic
+
+
+def _pick_head_chunk(L: int, H: int, D: int, in_blocks: int, in_itemsize: int,
+                     out_blocks: int, out_itemsize: int,
+                     n_f32_temps: int) -> int:
+    """Largest divisor of H whose double-buffered in/out blocks plus the
+    per-head [L, L] f32 temporaries fit the VMEM budget. Input and output
+    blocks are sized with their own dtypes (the public ``dtype`` default is
+    f32, twice the width of bf16 operands)."""
+    temps = n_f32_temps * L * L * 4
+    per_head = L * D * 2  # x2: Mosaic double-buffers each block
+    bytes_per_head = per_head * (
+        in_blocks * in_itemsize + out_blocks * out_itemsize
+    )
+    for hc in sorted((d for d in range(1, H + 1) if H % d == 0), reverse=True):
+        if bytes_per_head * hc + temps <= _VMEM_BUDGET:
+            return hc
+    return 1
 
 
 def _flash_forward(q, k, v, mask, seed, dtype, rate, interpret: bool):
     B, L, H, D = q.shape
-    spec_ld = pl.BlockSpec((1, 1, L, D), lambda b, h, *_: (b, h, 0, 0))
+    hc = _pick_head_chunk(L, H, D, in_blocks=3, in_itemsize=q.dtype.itemsize,
+                          out_blocks=1, out_itemsize=jnp.dtype(dtype).itemsize,
+                          n_f32_temps=3)
+    spec_lf = pl.BlockSpec((1, L, hc * D), lambda b, hj, *_: (b, 0, hj))
 
     out = pl.pallas_call(
         functools.partial(_fused_fwd_kernel, scale=1.0 / (D ** 0.5),
-                          rate=rate, heads=H),
+                          rate=rate, heads=H, hc=hc, D=D),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(B, H),
+            grid=(B, H // hc),
             in_specs=[
-                pl.BlockSpec((1, 1, L), lambda b, h, *_: (b, 0, 0)),  # mask
-                spec_ld, spec_ld, spec_ld,                            # q k v
+                pl.BlockSpec((1, 1, L), lambda b, hj, *_: (b, 0, 0)),  # mask
+                spec_lf, spec_lf, spec_lf,                             # q k v
             ],
-            out_specs=spec_ld,
+            out_specs=spec_lf,
         ),
-        out_shape=jax.ShapeDtypeStruct((B, H, L, D), dtype),
+        out_shape=jax.ShapeDtypeStruct((B, L, H * D), dtype),
         interpret=interpret,
-    )(seed, mask[:, None, :], _bhld(q), _bhld(k), _bhld(v))
-    return jnp.transpose(out, (0, 2, 1, 3))
+    )(seed, mask[:, None, :], _fold(q), _fold(k), _fold(v))
+    return out.reshape(B, L, H, D)
 
 
 def _flash_backward(q, k, v, mask, seed, g, dtype, rate, interpret: bool):
     B, L, H, D = q.shape
-    spec_ld = pl.BlockSpec((1, 1, L, D), lambda b, h, *_: (b, h, 0, 0))
+    hc = _pick_head_chunk(L, H, D, in_blocks=4, in_itemsize=q.dtype.itemsize,
+                          out_blocks=3, out_itemsize=q.dtype.itemsize,
+                          n_f32_temps=6)
+    spec_lf = pl.BlockSpec((1, L, hc * D), lambda b, hj, *_: (b, 0, hj))
 
     dq, dk, dv = pl.pallas_call(
         functools.partial(_fused_bwd_kernel, scale=1.0 / (D ** 0.5),
-                          rate=rate, heads=H),
+                          rate=rate, heads=H, hc=hc, D=D),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(B, H),
+            grid=(B, H // hc),
             in_specs=[
-                pl.BlockSpec((1, 1, L), lambda b, h, *_: (b, 0, 0)),  # mask
-                spec_ld, spec_ld, spec_ld, spec_ld,                   # q k v g
+                pl.BlockSpec((1, 1, L), lambda b, hj, *_: (b, 0, 0)),  # mask
+                spec_lf, spec_lf, spec_lf, spec_lf,                    # q k v g
             ],
-            out_specs=[spec_ld, spec_ld, spec_ld],
+            out_specs=[spec_lf, spec_lf, spec_lf],
         ),
-        out_shape=[jax.ShapeDtypeStruct((B, H, L, D), q.dtype)] * 3,
+        out_shape=[jax.ShapeDtypeStruct((B, L, H * D), q.dtype)] * 3,
         interpret=interpret,
-    )(seed, mask[:, None, :], _bhld(q), _bhld(k), _bhld(v), _bhld(g))
-    return tuple(jnp.transpose(x, (0, 2, 1, 3)) for x in (dq, dk, dv))
+    )(seed, mask[:, None, :], _fold(q), _fold(k), _fold(v), _fold(g))
+    return tuple(x.reshape(B, L, H, D) for x in (dq, dk, dv))
 
 
 def _blocked_forward(q, k, v, mask, dtype, interpret: bool):
     B, L, H, D = q.shape
     q_blk = _pick_q_block(L)
     assert q_blk is not None, f"unsupported sequence length {L}"
+    hc = _pick_head_chunk(L, H, D, in_blocks=3, in_itemsize=q.dtype.itemsize,
+                          out_blocks=1, out_itemsize=jnp.dtype(dtype).itemsize,
+                          n_f32_temps=3)
 
+    # q-blocks INNERMOST: the k/v index map is constant in qi, so Pallas
+    # keeps each head-group's full K/V resident across all q-blocks instead
+    # of re-streaming them L/q_blk times from HBM.
     out = pl.pallas_call(
-        functools.partial(_blocked_fwd_kernel, scale=1.0 / (D ** 0.5)),
-        grid=(B, H, L // q_blk),
+        functools.partial(_blocked_fwd_kernel, scale=1.0 / (D ** 0.5),
+                          hc=hc, D=D),
+        grid=(B, H // hc, L // q_blk),
         in_specs=[
-            pl.BlockSpec((1, 1, L), lambda b, h, qi: (b, 0, 0)),             # mask
-            pl.BlockSpec((1, 1, q_blk, D), lambda b, h, qi: (b, h, qi, 0)),  # q
-            pl.BlockSpec((1, 1, L, D), lambda b, h, qi: (b, h, 0, 0)),       # k
-            pl.BlockSpec((1, 1, L, D), lambda b, h, qi: (b, h, 0, 0)),       # v
+            pl.BlockSpec((1, 1, L), lambda b, hj, qi: (b, 0, 0)),            # mask
+            pl.BlockSpec((1, q_blk, hc * D), lambda b, hj, qi: (b, qi, hj)),  # q
+            pl.BlockSpec((1, L, hc * D), lambda b, hj, qi: (b, 0, hj)),       # k
+            pl.BlockSpec((1, L, hc * D), lambda b, hj, qi: (b, 0, hj)),       # v
         ],
-        out_specs=pl.BlockSpec((1, 1, q_blk, D), lambda b, h, qi: (b, h, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, L, D), dtype),
+        out_specs=pl.BlockSpec((1, q_blk, hc * D), lambda b, hj, qi: (b, qi, hj)),
+        out_shape=jax.ShapeDtypeStruct((B, L, H * D), dtype),
         interpret=interpret,
-    )(mask[:, None, :], _bhld(q), _bhld(k), _bhld(v))
-    return jnp.transpose(out, (0, 2, 1, 3))
+    )(mask[:, None, :], _fold(q), _fold(k), _fold(v))
+    return out.reshape(B, L, H, D)
 
 
 def _xla_reference(q, k, v, mask, dtype):

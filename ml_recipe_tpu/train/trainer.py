@@ -450,67 +450,52 @@ class Trainer:
             values = jax.tree_util.tree_map(lambda v: v * inv, values)
 
             # Loss-scale unscale/finite-check and global-norm clipping run
-            # over the accumulated f32 gradients — in the flat domain that is
-            # one fused kernel each, versus ~2 launches per parameter tensor
-            # for tree-wise ops (the optimizer chain is built without
-            # clip_by_global_norm; semantics identical to torch
-            # clip_grad_norm_ over the OPTIMIZED params: frozen modules are
-            # zeroed first so they contribute nothing to the norm).
-            if use_flat:
-                flat_grads = acc_grads * inv
-                if mask_leaves is not None:
-                    # where, not multiply: a frozen module's inf/nan gradient
-                    # must vanish (inf * 0 = nan would poison the clip norm /
-                    # trip the loss-scale finite check for params that are
-                    # not even optimized)
+            # over the accumulated f32 gradients. ONE pipeline serves both
+            # accumulation layouts — `acc_grads` is either the flat vector
+            # (a single-leaf pytree: every op below is one fused kernel) or
+            # the per-tensor tree; the math is identical (the single-leaf
+            # global norm reduces to the flat formula). Semantics match
+            # torch clip_grad_norm_ over the OPTIMIZED params: frozen
+            # modules are zeroed first (where/static zeros, not multiply —
+            # a frozen module's inf/nan gradient must vanish rather than
+            # poison the norm or trip the finite check for params that are
+            # not even optimized), and overflow steps contribute zero grads
+            # so optimizer moments stay untouched (masked below) and the
+            # update is a no-op.
+            grads = jax.tree_util.tree_map(lambda g: g * inv, acc_grads)
+            if tmask is not None:
+                if use_flat:
                     mask_vec = jnp.concatenate(
                         [
                             jnp.full((sizes[i],), bool(mask_leaves[i]))
                             for i in range(len(leaves))
                         ]
                     )
-                    flat_grads = jnp.where(mask_vec, flat_grads, 0.0)
-                if use_ls:
-                    flat_grads = ls_lib.unscale(flat_grads, ls_state)
-                    finite = ls_lib.all_finite(flat_grads)
-                    # overflow steps contribute zero grads so optimizer
-                    # moments stay untouched (masked below) and the update
-                    # is a no-op
-                    flat_grads = jnp.where(finite, flat_grads, 0.0)
-                if clip_norm is not None and clip_norm > 0:
-                    # optax.clip_by_global_norm semantics: g * c / max(norm, c)
-                    gnorm = jnp.sqrt(jnp.sum(flat_grads * flat_grads))
-                    flat_grads = flat_grads * (
-                        clip_norm / jnp.maximum(gnorm, clip_norm)
-                    )
-                grads = unflatten_grads(flat_grads)
-            else:
-                grads = jax.tree_util.tree_map(lambda g: g * inv, acc_grads)
-                if tmask is not None:
-                    # static zeroing (mask is known at trace time): frozen
-                    # leaves become literal zeros, so non-finite frozen grads
-                    # can't leak into the norm or the finite check
+                    grads = jnp.where(mask_vec, grads, 0.0)
+                else:
                     grads = jax.tree_util.tree_map(
                         lambda g, m: g if m else jnp.zeros_like(g), grads, tmask
                     )
-                if use_ls:
-                    grads = ls_lib.unscale(grads, ls_state)
-                    finite = ls_lib.all_finite(grads)
-                    grads = jax.tree_util.tree_map(
-                        lambda g: jnp.where(finite, g, 0.0), grads
-                    )
-                if clip_norm is not None and clip_norm > 0:
-                    gnorm = jnp.sqrt(
-                        sum(
-                            jnp.sum(g * g)
-                            for g in jax.tree_util.tree_leaves(grads)
-                        )
-                    )
-                    scale = clip_norm / jnp.maximum(gnorm, clip_norm)
-                    grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+            if use_ls:
+                grads = ls_lib.unscale(grads, ls_state)
+                finite = ls_lib.all_finite(grads)
                 grads = jax.tree_util.tree_map(
+                    lambda g: jnp.where(finite, g, 0.0), grads
+                )
+            if clip_norm is not None and clip_norm > 0:
+                # optax.clip_by_global_norm semantics: g * c / max(norm, c)
+                gnorm = jnp.sqrt(
+                    sum(jnp.sum(g * g) for g in jax.tree_util.tree_leaves(grads))
+                )
+                scale = clip_norm / jnp.maximum(gnorm, clip_norm)
+                grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+            grads = (
+                unflatten_grads(grads)
+                if use_flat
+                else jax.tree_util.tree_map(
                     lambda g, p: g.astype(p.dtype), grads, params
                 )
+            )
 
             updates, new_opt_state = optimizer.update(grads, opt_state, params)
             if self._zero_shardings is not None:
