@@ -209,29 +209,25 @@ def _fold(x):
 _VMEM_BUDGET = 12 * 1024 * 1024  # leave ~4 MB of the ~16 MB/core for Mosaic
 
 
-def _pick_head_chunk(L: int, H: int, D: int, in_blocks: int, in_itemsize: int,
-                     out_blocks: int, out_itemsize: int,
-                     n_f32_temps: int) -> int:
-    """Largest divisor of H whose double-buffered in/out blocks plus the
-    per-head [L, L] f32 temporaries fit the VMEM budget. Input and output
-    blocks are sized with their own dtypes (the public ``dtype`` default is
-    f32, twice the width of bf16 operands)."""
-    temps = n_f32_temps * L * L * 4
-    per_head = L * D * 2  # x2: Mosaic double-buffers each block
-    bytes_per_head = per_head * (
-        in_blocks * in_itemsize + out_blocks * out_itemsize
-    )
+def _pick_head_chunk(H: int, bytes_per_head: int, temp_bytes: int) -> int:
+    """Largest divisor of H whose per-head-group block bytes plus the fixed
+    temporaries fit the VMEM budget. Callers compute ``bytes_per_head`` from
+    their own block geometry and dtypes (x2 for Mosaic double-buffering) and
+    ``temp_bytes`` from their per-head f32 working set."""
     for hc in sorted((d for d in range(1, H + 1) if H % d == 0), reverse=True):
-        if bytes_per_head * hc + temps <= _VMEM_BUDGET:
+        if bytes_per_head * hc + temp_bytes <= _VMEM_BUDGET:
             return hc
     return 1
 
 
 def _flash_forward(q, k, v, mask, seed, dtype, rate, interpret: bool):
     B, L, H, D = q.shape
-    hc = _pick_head_chunk(L, H, D, in_blocks=3, in_itemsize=q.dtype.itemsize,
-                          out_blocks=1, out_itemsize=jnp.dtype(dtype).itemsize,
-                          n_f32_temps=3)
+    hc = _pick_head_chunk(
+        H,
+        bytes_per_head=2 * L * D * (3 * q.dtype.itemsize
+                                    + jnp.dtype(dtype).itemsize),
+        temp_bytes=3 * L * L * 4,  # scores/probs/dropout-uniform f32
+    )
     spec_lf = pl.BlockSpec((1, L, hc * D), lambda b, hj, *_: (b, 0, hj))
 
     out = pl.pallas_call(
@@ -254,9 +250,11 @@ def _flash_forward(q, k, v, mask, seed, dtype, rate, interpret: bool):
 
 def _flash_backward(q, k, v, mask, seed, g, dtype, rate, interpret: bool):
     B, L, H, D = q.shape
-    hc = _pick_head_chunk(L, H, D, in_blocks=4, in_itemsize=q.dtype.itemsize,
-                          out_blocks=3, out_itemsize=q.dtype.itemsize,
-                          n_f32_temps=6)
+    hc = _pick_head_chunk(
+        H,
+        bytes_per_head=2 * L * D * 7 * q.dtype.itemsize,  # q k v g dq dk dv
+        temp_bytes=6 * L * L * 4,  # s/p/keep/dp/ds f32 working set
+    )
     spec_lf = pl.BlockSpec((1, L, hc * D), lambda b, hj, *_: (b, 0, hj))
 
     dq, dk, dv = pl.pallas_call(
@@ -281,9 +279,15 @@ def _blocked_forward(q, k, v, mask, dtype, interpret: bool):
     B, L, H, D = q.shape
     q_blk = _pick_q_block(L)
     assert q_blk is not None, f"unsupported sequence length {L}"
-    hc = _pick_head_chunk(L, H, D, in_blocks=3, in_itemsize=q.dtype.itemsize,
-                          out_blocks=1, out_itemsize=jnp.dtype(dtype).itemsize,
-                          n_f32_temps=3)
+    # blocks: k/v carry L rows, q/o only q_blk; temporaries are [q_blk, L]
+    hc = _pick_head_chunk(
+        H,
+        bytes_per_head=2 * D * (
+            (2 * L + q_blk) * q.dtype.itemsize
+            + q_blk * jnp.dtype(dtype).itemsize
+        ),
+        temp_bytes=3 * q_blk * L * 4,
+    )
 
     # q-blocks INNERMOST: the k/v index map is constant in qi, so Pallas
     # keeps each head-group's full K/V resident across all q-blocks instead
