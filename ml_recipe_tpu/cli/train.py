@@ -22,6 +22,7 @@ from __future__ import annotations
 import functools
 import logging
 import os
+import signal
 from datetime import datetime
 
 from ..compose import init_collate_fun, init_datasets, init_loss, init_model
@@ -130,14 +131,28 @@ def run_worker(params, model_params) -> None:
         ],
     )
 
+    # TPU preemptions/evictions deliver SIGTERM (not SIGINT): route it into
+    # the same interrupt-checkpoint path as Ctrl-C (reference train.py:117-119
+    # only covered KeyboardInterrupt). Installed here — after Trainer
+    # construction — so a SIGTERM during compile/init still aborts cleanly.
+    def _sigterm_to_interrupt(signum, frame):
+        raise KeyboardInterrupt(f"signal {signum}")
+
+    prev_handler = signal.signal(signal.SIGTERM, _sigterm_to_interrupt)
     try:
         trainer.train(after_epoch_funcs=[save_last, save_each, test_fun])
     except KeyboardInterrupt:
+        # disarm first: a second SIGTERM during the (multi-second) save must
+        # not re-raise inside save_state_dict and abort the very checkpoint
+        # this path exists to produce
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
         local_logger.error("Training process was interrupted.")
         trainer.save_state_dict(params.dump_dir / params.experiment_name / "interrupt.ch")
     except Exception as e:
         local_logger.error(e)
         raise e
+    finally:
+        signal.signal(signal.SIGTERM, prev_handler)
 
 
 def main(params, model_params) -> None:

@@ -34,6 +34,8 @@ import sys
 from pathlib import Path
 from typing import Callable, Iterable, List, Optional, Sequence, Tuple
 
+from ..models.config import MODEL_PRESETS
+
 logger = logging.getLogger(__name__)
 
 
@@ -136,10 +138,16 @@ class ConfigArgumentParser(argparse.ArgumentParser):
                 continue
             if isinstance(action, (argparse._StoreTrueAction, argparse._StoreFalseAction)):
                 self.set_defaults(**{key: _str2bool(value)})
-            elif action.type is not None:
-                self.set_defaults(**{key: action.type(value)})
-            else:
-                self.set_defaults(**{key: value})
+                continue
+            converted = action.type(value) if action.type is not None else value
+            # set_defaults skips argparse's choice validation — enforce it
+            # here so a config-file typo fails as loudly as a CLI one
+            if action.choices is not None and converted not in action.choices:
+                self.error(
+                    f"argument --{key}: invalid choice: {converted!r} "
+                    f"(choose from {', '.join(map(str, action.choices))})"
+                )
+            self.set_defaults(**{key: converted})
         return unknown
 
     def parse_known_args(self, args=None, namespace=None):  # type: ignore[override]
@@ -222,12 +230,8 @@ def load_config_file(parser_getter, config_path):
 # Parser factories — flag surface parity with reference parser.py:60-207.
 # ---------------------------------------------------------------------------
 
-MODEL_CHOICES = [
-    "bert-base-uncased",
-    "bert-large-uncased",
-    "roberta-base",
-    "roberta-large",
-]
+# derived from the preset registry so the flag and the registry cannot drift
+MODEL_CHOICES = list(MODEL_PRESETS)
 
 
 def get_model_parser() -> ConfigArgumentParser:

@@ -165,3 +165,31 @@ def test_cli_train_metrics_end_to_end(e2e, monkeypatch):
         ["train_metrics", "-c", str(cfg), "--checkpoint", str(ckpt)],
     )
     train_metrics.cli()
+
+
+def test_cli_sigterm_saves_interrupt_checkpoint(e2e, monkeypatch):
+    """TPU preemptions deliver SIGTERM: the train CLI must route it into the
+    same interrupt-checkpoint path as Ctrl-C (interrupt.ch)."""
+    import os
+    import signal
+    import time
+
+    tmp, cfg, _ = e2e
+    from ml_recipe_tpu.cli import train
+    from ml_recipe_tpu.train import Trainer
+
+    def fake_train(self, *a, **k):
+        os.kill(os.getpid(), signal.SIGTERM)  # delivered to the main thread
+        time.sleep(5)  # interrupted immediately by the handler
+        raise AssertionError("SIGTERM handler did not fire")
+
+    monkeypatch.setattr(Trainer, "train", fake_train)
+    monkeypatch.setattr(
+        sys, "argv",
+        ["train", "-c", str(cfg), "--experiment_name", "sigterm"],
+    )
+    prev = signal.getsignal(signal.SIGTERM)
+    train.cli()
+    assert (tmp / "results" / "sigterm" / "interrupt.ch").exists()
+    # handler restored after the run
+    assert signal.getsignal(signal.SIGTERM) is prev

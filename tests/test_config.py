@@ -167,3 +167,24 @@ def test_predictor_parser():
     params, _ = parser.parse_known_args(["--checkpoint", "None", "--limit", "None"])
     assert params.checkpoint is None
     assert params.limit is None
+
+
+def test_config_file_choice_typo_fails_loudly(tmp_path):
+    """set_defaults-injected config values must hit the same `choices`
+    validation as CLI values (a cfg typo used to pass silently)."""
+    import pytest
+
+    from ml_recipe_tpu.config.parser import get_params, get_trainer_parser
+
+    cfg = tmp_path / "bad.cfg"
+    cfg.write_text("loss=smoooth\n")
+    with pytest.raises(SystemExit):
+        get_params((get_trainer_parser,), ["-c", str(cfg)])
+
+
+def test_model_choices_track_presets():
+    from ml_recipe_tpu.config.parser import MODEL_CHOICES
+    from ml_recipe_tpu.models.config import MODEL_PRESETS
+
+    assert MODEL_CHOICES == list(MODEL_PRESETS)
+    assert "bert-tiny" in MODEL_CHOICES
