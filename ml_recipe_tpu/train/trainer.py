@@ -553,14 +553,14 @@ class Trainer:
 
     # -- console / writer (trainer.py:206-219) --------------------------------
 
-    def _update_writer(self, meters: dict, *, prefix: str):
+    def _update_writer(self, meters: dict, *, prefix: str, step: Optional[int] = None):
         if self.writer is None:
             return
         for k, v in meters.items():
             self.writer.add_scalar(
                 f"{prefix}/{k}",
                 v() if isinstance(v, AverageMeter) else v,
-                global_step=self.global_step,
+                global_step=self.global_step if step is None else step,
             )
 
     # -- train loop (trainer.py:253-300) --------------------------------------
@@ -599,6 +599,24 @@ class Trainer:
         trace_from = (
             0 if self.debug or len(self.train_dataloader) < 5 else 2
         )
+        def consume(values, step_no: int) -> None:
+            # this device_get blocks until the producing step finishes — by
+            # then the NEXT step is already enqueued (see `pending` below),
+            # so the device never idles on host-side metric/IO work
+            host_values = jax.device_get(values)
+            for k, v in host_values.items():
+                if k == "lr":
+                    avg_meters["lr"] = float(v)
+                else:
+                    avg_meters[k].update(float(v))
+            self._update_writer(avg_meters, prefix="train", step=step_no)
+            if tqdm_data is not None:
+                tqdm_data.set_postfix_str(_console_str(avg_meters))
+
+        # Metrics are consumed with a ONE-STEP lag: dispatch step N, then
+        # fetch step N-1's scalars while N runs. Without this the per-step
+        # device_get serializes device compute with host batch prep.
+        pending = None
         for step_i, (inputs, labels) in enumerate(iterator):
             if not trace_started and epoch_i == 1 and step_i == trace_from:
                 jax.profiler.start_trace(str(self.trace_dir))
@@ -620,22 +638,17 @@ class Trainer:
                     f"written to {self.trace_dir}."
                 )
 
-            host_values = jax.device_get(values)
-            for k, v in host_values.items():
-                if k == "lr":
-                    avg_meters["lr"] = float(v)
-                else:
-                    avg_meters[k].update(float(v))
-
-            self._update_writer(avg_meters, prefix="train")
+            if pending is not None:
+                consume(*pending)
+            pending = (values, self.global_step)
             self.global_step += 1
-
-            if tqdm_data is not None:
-                tqdm_data.set_postfix_str(_console_str(avg_meters))
 
             if self.debug:
                 logger.info("Training was interrupted because of debug mode.")
                 break
+
+        if pending is not None:
+            consume(*pending)
 
         if trace_started and not trace_stopped:  # epoch ended mid-capture
             jax.block_until_ready(self.params)
