@@ -30,6 +30,7 @@ import numpy as np
 from ..data import RawPreprocessor
 from ..data.loader import ListDataloader
 from ..parallel import build_mesh, gather_to_host, make_global_array
+from ..utils.pipeline import LaggedConsumer
 
 logger = logging.getLogger(__name__)
 
@@ -178,7 +179,22 @@ class Predictor:
                 total=self.limit,
             )
 
+        def consume(dev_out, n_valid, items) -> None:
+            # gathers batch i while batch i+1 is already on device (same
+            # one-step-lag pipelining as the Trainer loops)
+            out = gather_to_host(dev_out)
+            out = {k: v[:n_valid] for k, v in out.items()}
+
+            self._update_candidates(out, items)
+
+            if save_dump:
+                self.dump.append(
+                    (out["scores"], out["start_ids"], out["end_ids"],
+                     out["labels"], items)
+                )
+
         with self.mesh:
+            lag = LaggedConsumer(consume)
             for batch_i, (inputs, labels, items) in enumerate(iterator):
                 n_valid = len(items)
                 if n_valid < self.batch_size:
@@ -190,19 +206,14 @@ class Predictor:
                     }
 
                 dev_inputs = make_global_array(inputs, self.mesh)
-                out = gather_to_host(self._jit_fwd(self.params, dev_inputs))
-                out = {k: v[:n_valid] for k, v in out.items()}
+                dev_out = self._jit_fwd(self.params, dev_inputs)
 
-                self._update_candidates(out, items)
-
-                if save_dump:
-                    self.dump.append(
-                        (out["scores"], out["start_ids"], out["end_ids"],
-                         out["labels"], items)
-                    )
+                lag.feed(dev_out, n_valid, items)
 
                 if self.limit is not None and batch_i >= self.limit:
                     break
+
+            lag.flush()
 
         return self
 

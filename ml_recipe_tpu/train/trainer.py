@@ -42,6 +42,7 @@ from ..data.loader import DataLoader, ShardedBatchSampler
 from ..metrics import AverageMeter
 from ..parallel import build_mesh, gather_to_host, make_global_array, shard_params
 from ..parallel.sharding import is_single_device
+from ..utils.pipeline import LaggedConsumer
 from ..utils.profiler import time_profiler
 from . import loss_scale as ls_lib
 from .callback import TestCallback
@@ -616,7 +617,8 @@ class Trainer:
         # Metrics are consumed with a ONE-STEP lag: dispatch step N, then
         # fetch step N-1's scalars while N runs. Without this the per-step
         # device_get serializes device compute with host batch prep.
-        pending = None
+        lag = LaggedConsumer(consume)
+        n_batches = len(self.train_dataloader)
         for step_i, (inputs, labels) in enumerate(iterator):
             if not trace_started and epoch_i == 1 and step_i == trace_from:
                 jax.profiler.start_trace(str(self.trace_dir))
@@ -638,17 +640,18 @@ class Trainer:
                     f"written to {self.trace_dir}."
                 )
 
-            if pending is not None:
-                consume(*pending)
-            pending = (values, self.global_step)
+            lag.feed(values, self.global_step)
             self.global_step += 1
+            if step_i == n_batches - 1:
+                # eager flush on the known-last batch: the progress bar is
+                # still open, so its final line includes every batch
+                lag.flush()
 
             if self.debug:
                 logger.info("Training was interrupted because of debug mode.")
                 break
 
-        if pending is not None:
-            consume(*pending)
+        lag.flush()
 
         if trace_started and not trace_stopped:  # epoch ended mid-capture
             jax.block_until_ready(self.params)
@@ -688,13 +691,11 @@ class Trainer:
             )
             iterator = enumerate(tqdm_data)
 
-        for i, (inputs, labels) in iterator:
+        def consume(i, labels, dev_labels, preds, values) -> None:
+            # blocks on batch i's results — batch i+1 is already enqueued
+            # (same one-step-lag pipelining as the train loop)
             n_valid = self._test_sampler.valid_count(i)
             is_partial = n_valid < self._test_sampler.global_batch_size
-            dev_inputs = self._global_batch(inputs)
-            dev_labels = self._global_batch(labels)
-
-            preds, values = self._jit_eval_step(self.params, dev_inputs, dev_labels)
 
             host_preds = host_labels = None
             if callbacks is not None or is_partial:
@@ -709,12 +710,14 @@ class Trainer:
             if is_partial:
                 # the device loss averaged over pad-duplicated rows; recompute
                 # on the trimmed batch so meters see only real examples
-                _, values = self.loss(
+                _, values_ = self.loss(
                     {k: jnp.asarray(v) for k, v in host_preds.items()},
                     {k: jnp.asarray(v) for k, v in host_labels.items()},
                 )
+            else:
+                values_ = values
 
-            host_values = jax.device_get(values)
+            host_values = jax.device_get(values_)
             for k, v in host_values.items():
                 avg_meters[k].update(float(v))
 
@@ -725,9 +728,23 @@ class Trainer:
             if tqdm_data is not None:
                 tqdm_data.set_postfix_str(_console_str(avg_meters))
 
+        lag = LaggedConsumer(consume)
+        n_batches = len(self.test_dataloader)
+        for i, (inputs, labels) in iterator:
+            dev_inputs = self._global_batch(inputs)
+            dev_labels = self._global_batch(labels)
+
+            preds, values = self._jit_eval_step(self.params, dev_inputs, dev_labels)
+
+            lag.feed(i, labels, dev_labels, preds, values)
+            if i == n_batches - 1:
+                lag.flush()  # last batch reaches the still-open progress bar
+
             if self.debug and i >= 10:
                 logger.info("Test was interrupted because of debug mode.")
                 break
+
+        lag.flush()
 
         if callbacks is not None:
             for callback in callbacks:

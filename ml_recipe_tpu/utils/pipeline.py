@@ -1,0 +1,37 @@
+"""One-step-lag host/device pipelining.
+
+The device-bound loops (train step, eval step, predictor forward) all follow
+the same shape: dispatch batch N to the device, then do the host-side work
+(device_get, gathers, metric/callback updates) for batch N-1 — by which time
+batch N is already enqueued, so the device never idles on host work. This
+helper keeps the feed/flush discipline (including the trailing flush that a
+hand-rolled copy can silently forget) in one place.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+class LaggedConsumer:
+    """Calls ``consume(*args)`` one ``feed`` late; ``flush`` drains the tail.
+
+    ``feed(*args)`` consumes the PREVIOUSLY fed item (if any) and stores the
+    new one. ``flush()`` consumes the stored item — call it after the loop
+    and on every early-exit path, or use eagerly on a known-last iteration
+    so progress displays include the final item before they close.
+    """
+
+    def __init__(self, consume: Callable[..., None]):
+        self._consume = consume
+        self._pending = None
+
+    def feed(self, *args) -> None:
+        if self._pending is not None:
+            self._consume(*self._pending)
+        self._pending = args
+
+    def flush(self) -> None:
+        if self._pending is not None:
+            pending, self._pending = self._pending, None
+            self._consume(*pending)

@@ -62,3 +62,21 @@ def test_step_timer():
         t.stop()
     assert t.count == 3
     assert t.mean() >= 0.0
+
+
+def test_lagged_consumer_orders_and_flushes():
+    from ml_recipe_tpu.utils.pipeline import LaggedConsumer
+
+    seen = []
+    lag = LaggedConsumer(lambda *a: seen.append(a))
+    lag.feed(1, "a")
+    assert seen == []          # first feed: nothing consumed yet
+    lag.feed(2, "b")
+    assert seen == [(1, "a")]  # one-step lag
+    lag.flush()
+    assert seen == [(1, "a"), (2, "b")]
+    lag.flush()                # idempotent
+    assert seen == [(1, "a"), (2, "b")]
+    lag.feed(3, "c")
+    lag.flush()
+    assert seen[-1] == (3, "c")
