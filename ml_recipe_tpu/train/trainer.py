@@ -617,8 +617,7 @@ class Trainer:
         # Metrics are consumed with a ONE-STEP lag: dispatch step N, then
         # fetch step N-1's scalars while N runs. Without this the per-step
         # device_get serializes device compute with host batch prep.
-        lag = LaggedConsumer(consume)
-        n_batches = len(self.train_dataloader)
+        lag = LaggedConsumer(consume, total=len(self.train_dataloader))
         for step_i, (inputs, labels) in enumerate(iterator):
             if not trace_started and epoch_i == 1 and step_i == trace_from:
                 jax.profiler.start_trace(str(self.trace_dir))
@@ -642,10 +641,6 @@ class Trainer:
 
             lag.feed(values, self.global_step)
             self.global_step += 1
-            if step_i == n_batches - 1:
-                # eager flush on the known-last batch: the progress bar is
-                # still open, so its final line includes every batch
-                lag.flush()
 
             if self.debug:
                 logger.info("Training was interrupted because of debug mode.")
@@ -728,8 +723,7 @@ class Trainer:
             if tqdm_data is not None:
                 tqdm_data.set_postfix_str(_console_str(avg_meters))
 
-        lag = LaggedConsumer(consume)
-        n_batches = len(self.test_dataloader)
+        lag = LaggedConsumer(consume, total=len(self.test_dataloader))
         for i, (inputs, labels) in iterator:
             dev_inputs = self._global_batch(inputs)
             dev_labels = self._global_batch(labels)
@@ -737,8 +731,6 @@ class Trainer:
             preds, values = self._jit_eval_step(self.params, dev_inputs, dev_labels)
 
             lag.feed(i, labels, dev_labels, preds, values)
-            if i == n_batches - 1:
-                lag.flush()  # last batch reaches the still-open progress bar
 
             if self.debug and i >= 10:
                 logger.info("Test was interrupted because of debug mode.")

@@ -10,26 +10,33 @@ hand-rolled copy can silently forget) in one place.
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, Optional
 
 
 class LaggedConsumer:
     """Calls ``consume(*args)`` one ``feed`` late; ``flush`` drains the tail.
 
     ``feed(*args)`` consumes the PREVIOUSLY fed item (if any) and stores the
-    new one. ``flush()`` consumes the stored item — call it after the loop
-    and on every early-exit path, or use eagerly on a known-last iteration
-    so progress displays include the final item before they close.
+    new one. When ``total`` is given (the known number of feeds), the final
+    ``feed`` consumes its own item immediately — so progress displays that
+    close with the loop still include the last item. ``flush()`` consumes
+    any stored item; call it after the loop (covers early exits and
+    unknown-length streams) — it is idempotent.
     """
 
-    def __init__(self, consume: Callable[..., None]):
+    def __init__(self, consume: Callable[..., None], total: Optional[int] = None):
         self._consume = consume
+        self._total = total
+        self._fed = 0
         self._pending = None
 
     def feed(self, *args) -> None:
         if self._pending is not None:
             self._consume(*self._pending)
         self._pending = args
+        self._fed += 1
+        if self._total is not None and self._fed >= self._total:
+            self.flush()
 
     def flush(self) -> None:
         if self._pending is not None:

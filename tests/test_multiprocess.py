@@ -1,0 +1,199 @@
+"""True multi-process distributed bootstrap: two OS processes rendezvous via
+``jax.distributed`` driven by the platform env contract
+(MASTER_IP/MASTER_PORT/WORLD_SIZE/LOCAL_RANK — reference live.yml:126-132,
+worker.sh), form ONE global mesh over both processes' devices, and agree on a
+cross-process collective. This is the multi-host path the TPU pod launcher
+uses, exercised on CPU devices (SURVEY.md §4's fake/local mesh mode)."""
+
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+WORKER = r"""
+import os, sys
+sys.path.insert(0, os.environ["REPO_ROOT"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from ml_recipe_tpu.parallel import (
+    barrier, build_mesh, initialize_from_env, is_primary, make_global_array,
+)
+from ml_recipe_tpu.parallel.dist import process_count, process_index
+
+initialize_from_env()
+assert process_count() == 2, process_count()
+rank = process_index()
+assert rank == int(os.environ["LOCAL_RANK"]), (rank, os.environ["LOCAL_RANK"])
+assert is_primary() == (rank == 0)
+
+n = len(jax.devices())
+assert n == 2 * len(jax.local_devices()), (n, len(jax.local_devices()))
+
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+mesh = build_mesh()  # data axis over ALL devices of both processes
+assert mesh.devices.size == n
+
+# per-process local shard -> one global array -> global mean must combine
+# both processes' data (rank 0 holds zeros, rank 1 holds ones -> mean 0.5)
+local = np.full((4, 2), float(rank), dtype=np.float32)
+glob = make_global_array({"x": local}, mesh)["x"]
+assert glob.shape[0] == 8, glob.shape
+
+mean = jax.jit(
+    lambda x: jax.numpy.mean(x),
+    out_shardings=NamedSharding(mesh, P()),
+)(glob)
+val = float(mean)
+assert abs(val - 0.5) < 1e-6, val
+
+barrier("mp_test")
+print(f"WORKER_OK rank={rank} devices={n} mean={val}", flush=True)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_world(script, tmp_path, *, extra_env=None, timeout=300, attempts=3):
+    """Spawn a 2-process world on a fresh port; retry on port-steal races
+    (the port is released before the rank-0 coordinator binds it)."""
+    last = None
+    for _ in range(attempts):
+        port = _free_port()
+        procs = []
+        for rank in range(2):
+            env = dict(os.environ)
+            env.update(
+                REPO_ROOT=str(REPO),
+                WORK_DIR=str(tmp_path),
+                MASTER_IP="127.0.0.1",
+                MASTER_PORT=str(port),
+                WORLD_SIZE="2",
+                LOCAL_RANK=str(rank),
+                JAX_PLATFORMS="cpu",
+            )
+            env.pop("XLA_FLAGS", None)  # default 1 CPU device per process
+            if extra_env:
+                env.update(extra_env)
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, str(script)], env=env,
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                )
+            )
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise
+            outs.append(out)
+        last = list(zip(procs, outs))
+        if any("already in use" in o or "Failed to bind" in o for o in outs):
+            continue  # lost the port race — retry on a fresh port
+        return last
+    return last
+
+
+def test_two_process_bootstrap_and_collective(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+
+    for rank, (p, out) in enumerate(_run_world(script, tmp_path, timeout=180)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"WORKER_OK rank={rank} devices=2" in out, out
+
+
+TRAIN_WORKER = r"""
+import os, sys
+sys.path.insert(0, os.environ["REPO_ROOT"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from ml_recipe_tpu.data.collate import make_collate_fun
+from ml_recipe_tpu.data.datasets import DummyDataset
+from ml_recipe_tpu.losses import build_loss
+from ml_recipe_tpu.models import EncoderConfig, QAModel
+from ml_recipe_tpu.parallel import build_mesh, initialize_from_env, is_primary
+from ml_recipe_tpu.tokenizer import Tokenizer
+from ml_recipe_tpu.train import Trainer
+
+initialize_from_env()
+
+vocab = os.path.join(os.environ["WORK_DIR"], "vocab.txt")
+if is_primary():
+    with open(vocab + ".tmp", "w") as f:
+        f.write("\n".join(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+                          + [f"tok{i}" for i in range(45)]))
+    os.replace(vocab + ".tmp", vocab)
+from ml_recipe_tpu.parallel import barrier
+barrier("vocab")
+tok = Tokenizer("bert", vocab)
+
+class TP:
+    loss = "ce"; smooth_alpha = 0.01; focal_alpha = 1; focal_gamma = 2
+    w_start = 1; w_end = 1; w_start_reg = 0.5; w_end_reg = 0.5; w_cls = 1
+    lr = 1e-3; weight_decay = 0.01; warmup_coef = 0.0
+    optimizer = "adam"; finetune = False
+
+rng = np.random.default_rng(0)  # same seed -> identical dataset on each host
+tr = DummyDataset(tokenizer=tok, max_seq_len=48, max_question_len=12,
+                  dataset_len=32, rng=rng)
+te = DummyDataset(tokenizer=tok, max_seq_len=48, max_question_len=12,
+                  dataset_len=10, rng=rng)
+
+cfg = EncoderConfig(vocab_size=len(tok), hidden_size=16, num_layers=2,
+                    num_heads=2, intermediate_size=32,
+                    max_position_embeddings=50, num_labels=5,
+                    hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+model = QAModel(cfg)
+params = model.init(jax.random.key(0),
+                    np.asarray(tr[0].input_ids, np.int32)[None, :])["params"]
+
+t = Trainer(model=model, params=params, loss=build_loss(TP()),
+            collate_fun=make_collate_fun(tok, max_seq_len=48),
+            trainer_params=TP(), train_dataset=tr, test_dataset=te,
+            mesh=build_mesh(), n_epochs=1, train_batch_size=16,
+            test_batch_size=8, batch_split=2, n_jobs=0,
+            warmup_coef=0.0, max_grad_norm=1.0, seed=0)
+metrics = []
+t.train(after_epoch_funcs=[lambda e: metrics.append(t.test(e)["loss"])])
+
+# replica consistency: params are replicated over the global mesh — every
+# process must hold bit-identical values after distributed training
+leaves = jax.tree_util.tree_leaves(t.params)
+checksum = float(sum(np.asarray(l, dtype=np.float64).sum() for l in leaves))
+ckpt = os.path.join(os.environ["WORK_DIR"], "mp_last.ch")
+t.save_state_dict(ckpt)  # primary-gated internally
+print(f"TRAIN_OK rank={jax.process_index()} step={t.global_step} "
+      f"loss={metrics[0]:.6f} checksum={checksum:.6f}", flush=True)
+"""
+
+
+def test_two_process_training_replicas_agree(tmp_path):
+    script = tmp_path / "train_worker.py"
+    script.write_text(TRAIN_WORKER)
+
+    lines = []
+    for rank, (p, out) in enumerate(_run_world(script, tmp_path)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        ok = [l for l in out.splitlines() if l.startswith("TRAIN_OK")]
+        assert ok, out
+        lines.append(ok[0])
+
+    # both replicas trained the same trajectory: same step, loss, checksum
+    assert lines[0].split("rank=0 ")[1] == lines[1].split("rank=1 ")[1], lines
+    assert (tmp_path / "mp_last.ch").exists()  # primary-only checkpoint write

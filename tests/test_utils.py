@@ -80,3 +80,16 @@ def test_lagged_consumer_orders_and_flushes():
     lag.feed(3, "c")
     lag.flush()
     assert seen[-1] == (3, "c")
+
+
+def test_lagged_consumer_total_autoflushes():
+    from ml_recipe_tpu.utils.pipeline import LaggedConsumer
+
+    seen = []
+    lag = LaggedConsumer(lambda x: seen.append(x), total=3)
+    lag.feed(1); lag.feed(2)
+    assert seen == [1]
+    lag.feed(3)            # final feed: consumes 2 AND 3 (auto-flush)
+    assert seen == [1, 2, 3]
+    lag.flush()            # still idempotent afterwards
+    assert seen == [1, 2, 3]
