@@ -209,21 +209,30 @@ def _fold(x):
 _VMEM_BUDGET = 12 * 1024 * 1024  # leave ~4 MB of the ~16 MB/core for Mosaic
 
 
-def _pick_head_chunk(H: int, bytes_per_head: int, temp_bytes: int) -> int:
-    """Largest divisor of H whose per-head-group block bytes plus the fixed
-    temporaries fit the VMEM budget. Callers compute ``bytes_per_head`` from
-    their own block geometry and dtypes (x2 for Mosaic double-buffering) and
-    ``temp_bytes`` from their per-head f32 working set."""
-    for hc in sorted((d for d in range(1, H + 1) if H % d == 0), reverse=True):
+def _pick_head_chunk(H: int, D: int, bytes_per_head: int,
+                     temp_bytes: int) -> int:
+    """Largest LEGAL divisor of H whose per-head-group block bytes plus the
+    fixed temporaries fit the VMEM budget. Legal means the block's lane dim
+    (hc*D) is 128-divisible or spans the whole folded array (Mosaic rejects
+    other widths — hc=3 with D=64 gives 192 lanes and fails to lower).
+    Callers compute ``bytes_per_head`` from their own block geometry and
+    dtypes (x2 for Mosaic double-buffering) and ``temp_bytes`` from their
+    per-head f32 working set. Falls back to the smallest legal chunk when
+    nothing fits the budget (best effort — Mosaic may still OOM loudly)."""
+    legal = [
+        d for d in range(1, H + 1)
+        if H % d == 0 and ((d * D) % 128 == 0 or d == H)
+    ]
+    for hc in sorted(legal, reverse=True):
         if bytes_per_head * hc + temp_bytes <= _VMEM_BUDGET:
             return hc
-    return 1
+    return min(legal)
 
 
 def _flash_forward(q, k, v, mask, seed, dtype, rate, interpret: bool):
     B, L, H, D = q.shape
     hc = _pick_head_chunk(
-        H,
+        H, D,
         bytes_per_head=2 * L * D * (3 * q.dtype.itemsize
                                     + jnp.dtype(dtype).itemsize),
         temp_bytes=3 * L * L * 4,  # scores/probs/dropout-uniform f32
@@ -251,7 +260,7 @@ def _flash_forward(q, k, v, mask, seed, dtype, rate, interpret: bool):
 def _flash_backward(q, k, v, mask, seed, g, dtype, rate, interpret: bool):
     B, L, H, D = q.shape
     hc = _pick_head_chunk(
-        H,
+        H, D,
         bytes_per_head=2 * L * D * 7 * q.dtype.itemsize,  # q k v g dq dk dv
         temp_bytes=6 * L * L * 4,  # s/p/keep/dp/ds f32 working set
     )
@@ -281,7 +290,7 @@ def _blocked_forward(q, k, v, mask, dtype, interpret: bool):
     assert q_blk is not None, f"unsupported sequence length {L}"
     # blocks: k/v carry L rows, q/o only q_blk; temporaries are [q_blk, L]
     hc = _pick_head_chunk(
-        H,
+        H, D,
         bytes_per_head=2 * D * (
             (2 * L + q_blk) * q.dtype.itemsize
             + q_blk * jnp.dtype(dtype).itemsize

@@ -189,3 +189,22 @@ def test_flash_dropout_backward_consistent_with_forward():
     analytic_q = float(jnp.sum(gq * dq))
     numeric_q = float((fq(q + eps * dq) - fq(q - eps * dq)) / (2 * eps))
     assert abs(analytic_q - numeric_q) < 1e-2 * max(1.0, abs(numeric_q))
+
+
+def test_pick_head_chunk_always_mosaic_legal():
+    """The chosen head group's lane width (hc*D) must be 128-divisible or
+    span the whole folded array — Mosaic rejects other block widths (found
+    on hardware: hc=3 with D=64 -> 192 lanes fails to lower; interpret mode
+    cannot catch this)."""
+    from ml_recipe_tpu.ops.flash_attention import _pick_head_chunk
+
+    for H in (1, 2, 3, 4, 6, 8, 12, 16, 24):
+        for D in (32, 64, 128):
+            for budget_stress in (1, 10, 100):  # force small hc via big blocks
+                hc = _pick_head_chunk(
+                    H, D,
+                    bytes_per_head=budget_stress * 512 * D * 14,
+                    temp_bytes=6 * 512 * 512 * 4,
+                )
+                assert H % hc == 0
+                assert (hc * D) % 128 == 0 or hc == H, (H, D, hc)
