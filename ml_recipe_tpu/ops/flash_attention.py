@@ -12,12 +12,17 @@ transposes inserted; XLA fuses the [B,H,L,D] relayout into the projection
 matmuls).
 
 Three regimes:
-- ``L <= _FUSED_BWD_MAX_LEN``: fully fused — one program per (batch, head)
-  computes the whole head in VMEM, forward and backward, with optional
-  attention-probs dropout applied INSIDE the kernel. This covers the
-  reference's training shape (max_seq_len <= 512, config/test_bert.cfg:66).
-- larger L, no dropout: q-blocked forward kernel + XLA-recompute backward
-  (exact, but scores materialize in HBM during the backward).
+- ``L <= _FUSED_BWD_MAX_LEN``: fully fused — one program per (batch,
+  head-group) computes whole heads in VMEM, forward and backward, with
+  optional attention-probs dropout applied INSIDE the kernel. This covers
+  the reference's training shape (max_seq_len <= 512, config/test_bert.cfg:66).
+- larger L (VMEM-feasible, no dropout — ~2k at bf16/D=64): q-blocked
+  forward AND backward kernels. The whole per-head-group K/V stays
+  VMEM-resident, so each q-block program computes the exact full-row
+  softmax (no lse residuals) and dk/dv accumulate in f32 across the q
+  sweep in revisited output blocks — the [B, H, L, L] score tensor never
+  exists in HBM in either direction. ``_blocked_bwd_cfg`` decides
+  feasibility; infeasible shapes fall back to the XLA-recompute backward.
 - anything else: the dispatcher (ops/attention.py) uses the XLA path.
 
 Dropout determinism: the backward must regenerate the exact forward mask. The
@@ -109,6 +114,47 @@ def _fused_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, :, sl] = o.astype(o_ref.dtype)
 
 
+def _attention_bwd_math(q, k, v, g, mask, scale, *, drop=None):
+    """Exact softmax-attention backward for one head, probabilities
+    recomputed in VMEM. ``q``/``g`` may be a q-block; ``k``/``v`` are the
+    full rows. ``drop``: optional ``(keep_bool_grid, inv_rate)`` applying
+    the forward's dropout in-kernel. Returns ``(dq, dk, dv)`` in f32,
+    where dk/dv have k's row count."""
+    p = _softmax_probs(q, k, mask, scale)  # [q_rows, L] f32, pre-dropout
+    if drop is not None:
+        keep, inv = drop
+        p_drop = jnp.where(keep, p * inv, 0.0)
+    else:
+        p_drop = p
+
+    # dv = p_drop^T g
+    dv = jax.lax.dot_general(
+        p_drop.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    # dp_drop = g v^T
+    dp_drop = jax.lax.dot_general(
+        g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    # dropout backward, then softmax backward
+    if drop is not None:
+        dp = jnp.where(keep, dp_drop * inv, 0.0)
+    else:
+        dp = dp_drop
+    row = jnp.sum(dp * p, axis=-1, keepdims=True)
+    ds = p * (dp - row)  # f32; zero on masked keys since p is zero there
+
+    dq = jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    dk = jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    return dq, dk, dv
+
+
 def _fused_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
                       dq_ref, dk_ref, dv_ref,
                       *, scale: float, rate: float, heads: int, hc: int,
@@ -125,46 +171,55 @@ def _fused_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
         v = v_ref[0, :, sl]
         g = g_ref[0, :, sl]
 
-        p = _softmax_probs(q, k, mask, scale)  # [L, L] f32, pre-dropout
-
+        drop = None
         if rate > 0.0:
             keep = _uniform_grid(
                 seed_ref[0], b * heads + hj * hc + h, q.shape[0]
             ) >= rate
-            inv = jnp.float32(1.0 / (1.0 - rate))
-            p_drop = jnp.where(keep, p * inv, 0.0)
-        else:
-            p_drop = p
+            drop = (keep, jnp.float32(1.0 / (1.0 - rate)))
 
-        # dv = p_drop^T g
-        dv = jax.lax.dot_general(
-            p_drop.astype(g.dtype), g, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        # dp_drop = g v^T
-        dp_drop = jax.lax.dot_general(
-            g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        # dropout backward, then softmax backward
-        if rate > 0.0:
-            dp = jnp.where(keep, dp_drop * inv, 0.0)
-        else:
-            dp = dp_drop
-        row = jnp.sum(dp * p, axis=-1, keepdims=True)
-        ds = p * (dp - row)  # [L, L] f32; zero on masked keys since p is zero
-
-        dq = jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        dk = jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
+        dq, dk, dv = _attention_bwd_math(q, k, v, g, mask, scale, drop=drop)
 
         dq_ref[0, :, sl] = dq.astype(dq_ref.dtype)
         dk_ref[0, :, sl] = dk.astype(dk_ref.dtype)
         dv_ref[0, :, sl] = dv.astype(dv_ref.dtype)
+
+
+def _blocked_bwd_kernel(mask_ref, q_ref, k_ref, v_ref, g_ref,
+                        dq_ref, dk_ref, dv_ref,
+                        *, scale: float, hc: int, D: int):
+    """Fused long-sequence backward: one (batch, head-group, q-block)
+    program. The whole K/V for the head group stays resident in VMEM, so
+    each program computes the EXACT full-row softmax for its q rows (no
+    saved lse/max residuals needed) and the full [q_blk, L] score gradient.
+    dq writes its own q-block; dk/dv accumulate in f32 into output blocks
+    whose index map is constant in the q-block dimension — Pallas keeps
+    them resident across the q sweep and writes back once per (b, hj).
+    No dropout in this regime (dispatcher guarantees rate == 0)."""
+    qi = pl.program_id(2)
+
+    mask = mask_ref[0, 0, :]
+    for h in range(hc):
+        sl = slice(h * D, (h + 1) * D)
+        dq, dk, dv = _attention_bwd_math(
+            q_ref[0, :, sl],   # [q_blk, D]
+            k_ref[0, :, sl],   # [L, D] (whole)
+            v_ref[0, :, sl],   # [L, D] (whole)
+            g_ref[0, :, sl],   # [q_blk, D]
+            mask, scale,
+        )
+
+        dq_ref[0, :, sl] = dq.astype(dq_ref.dtype)
+
+        @pl.when(qi == 0)
+        def _init():
+            dk_ref[0, :, sl] = dk
+            dv_ref[0, :, sl] = dv
+
+        @pl.when(qi > 0)
+        def _accum():
+            dk_ref[0, :, sl] += dk
+            dv_ref[0, :, sl] += dv
 
 
 def _blocked_fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref,
@@ -318,6 +373,75 @@ def _blocked_forward(q, k, v, mask, dtype, interpret: bool):
     return out.reshape(B, L, H, D)
 
 
+def _blocked_bwd_cfg(L: int, H: int, D: int, in_itemsize: int):
+    """(q_blk, hc) for the fused q-blocked backward, or ``None`` when no
+    configuration fits the VMEM budget (the caller then falls back to the
+    XLA-recompute backward instead of letting Mosaic OOM on hardware).
+
+    Working set per program: [q_blk, L] f32 temporaries (p, dp, ds + softmax
+    scratch, ~4 deep); blocks: q/g/dq at q_blk rows and k/v at L rows
+    (input dtype, double-buffered), dk/dv at L rows in f32 (revisited
+    accumulators, not double-buffered)."""
+    q_blk = _pick_q_block(L)
+    if q_blk is None:
+        return None
+    while q_blk > 128 and 4 * q_blk * L * 4 > _VMEM_BUDGET // 2:
+        q_blk //= 2
+    temp_bytes = 4 * q_blk * L * 4
+    legal = [
+        d for d in range(1, H + 1)
+        if H % d == 0 and ((d * D) % 128 == 0 or d == H)
+    ]
+    for hc in sorted(legal, reverse=True):
+        block_bytes = hc * D * (
+            2 * (2 * L + 3 * q_blk) * in_itemsize + 2 * L * 4
+        )
+        if block_bytes + temp_bytes <= _VMEM_BUDGET:
+            return q_blk, hc
+    return None
+
+
+def supports_blocked_bwd(L: int, H: int = 12, D: int = 64,
+                         in_itemsize: int = 2) -> bool:
+    """True when the fused q-blocked backward applies (no dropout) AND a
+    VMEM-feasible configuration exists for the given head geometry."""
+    return (
+        L > _FUSED_BWD_MAX_LEN
+        and _blocked_bwd_cfg(L, H, D, in_itemsize) is not None
+    )
+
+
+def _blocked_backward(q, k, v, mask, g, q_blk, hc, dtype, interpret: bool):
+    B, L, H, D = q.shape
+
+    spec_q = pl.BlockSpec((1, q_blk, hc * D), lambda b, hj, qi: (b, qi, hj))
+    spec_l = pl.BlockSpec((1, L, hc * D), lambda b, hj, qi: (b, 0, hj))
+
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_blocked_bwd_kernel, scale=1.0 / (D ** 0.5),
+                          hc=hc, D=D),
+        grid=(B, H // hc, L // q_blk),
+        in_specs=[
+            pl.BlockSpec((1, 1, L), lambda b, hj, qi: (b, 0, 0)),  # mask
+            spec_q,                                                # q block
+            spec_l, spec_l,                                        # k v whole
+            spec_q,                                                # g block
+        ],
+        out_specs=[spec_q, spec_l, spec_l],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, L, H * D), q.dtype),      # dq
+            jax.ShapeDtypeStruct((B, L, H * D), jnp.float32),  # dk (f32 acc)
+            jax.ShapeDtypeStruct((B, L, H * D), jnp.float32),  # dv (f32 acc)
+        ],
+        interpret=interpret,
+    )(mask[:, None, :], _fold(q), _fold(k), _fold(v), _fold(g))
+    return (
+        dq.reshape(B, L, H, D),
+        dk.reshape(B, L, H, D).astype(k.dtype),
+        dv.reshape(B, L, H, D).astype(v.dtype),
+    )
+
+
 def _xla_reference(q, k, v, mask, dtype):
     """Einsum attention used for the long-sequence backward — the
     dispatcher's XLA path itself, so kernel and fallback cannot drift."""
@@ -342,11 +466,20 @@ def _fwd(q, k, v, mask, seed, dtype, rate, interpret):
 
 def _bwd(dtype, rate, interpret, residuals, g):
     q, k, v, mask, seed = residuals
-    if supports_fused_bwd(q.shape[1]):
+    L = q.shape[1]
+    if supports_fused_bwd(L):
         dq, dk, dv = _flash_backward(
             q, k, v, mask, seed, g.astype(q.dtype), dtype, rate, interpret
         )
         return dq, dk, dv, None, None
+    if L > _FUSED_BWD_MAX_LEN:
+        H, D = q.shape[2], q.shape[3]
+        cfg = _blocked_bwd_cfg(L, H, D, q.dtype.itemsize)
+        if cfg is not None:
+            dq, dk, dv = _blocked_backward(
+                q, k, v, mask, g.astype(q.dtype), *cfg, dtype, interpret
+            )
+            return dq, dk, dv, None, None
     _, vjp = jax.vjp(
         lambda q_, k_, v_: _xla_reference(q_, k_, v_, mask, dtype), q, k, v
     )
