@@ -552,3 +552,15 @@ def test_finetune_legacy_checkpoint_migrates(tmp_path):
     t2, _ = _make_trainer(tmp_path, tp_cls=FinetuneTP, debug=True)
     t2.load_state_dict(legacy_path)  # must not raise
     assert t2.global_step == t.global_step
+
+
+def test_trace_writes_xplane_steady_state(tmp_path):
+    """trace_dir dumps a device profile of the steady-state steps 2-4
+    (SURVEY.md §5 tracing parity: the reference had only wall-time
+    logging). 80 samples / batch 16 = 5 steps, so the documented capture
+    window (not the short-epoch fallback) is exercised."""
+    trainer, _ = _make_trainer(tmp_path, train_len=80)
+    trainer.trace_dir = tmp_path / "trace"
+    trainer.train()
+    dumped = list((tmp_path / "trace").rglob("*.xplane.pb"))
+    assert dumped, "no xplane profile written for the steady-state window"
