@@ -33,9 +33,20 @@ def bytes_to_unicode() -> Dict[int, str]:
     return dict(zip(bs, [chr(c) for c in cs]))
 
 
-_GPT2_SPLIT = re.compile(
-    r"'s|'t|'re|'ve|'m|'ll|'d| ?[^\s\d\W]+| ?\d+| ?[^\s\w]+|\s+(?!\S)|\s+"
-)
+try:
+    # the exact GPT-2 pre-split pattern needs \p{L}/\p{N} classes (letters
+    # exclude '_'; numbers include Nl/No like 'Ⅻ'/'½'), which stdlib `re`
+    # cannot express — `regex` ships with transformers, so it is always
+    # present in practice; the `re` fallback is approximate on those classes
+    import regex as _re_mod
+
+    _GPT2_SPLIT = _re_mod.compile(
+        r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"
+    )
+except ImportError:  # pragma: no cover
+    _GPT2_SPLIT = re.compile(
+        r"'s|'t|'re|'ve|'m|'ll|'d| ?[^\W\d_]+| ?\d+| ?(?:[^\s\w]|_)+|\s+(?!\S)|\s+"
+    )
 
 
 class ByteLevelBPETokenizer:
@@ -114,15 +125,21 @@ class ByteLevelBPETokenizer:
         return [self.vocab.get(t, unk) for t in self.tokenize(text)]
 
     def decode(self, ids: List[int], *, skip_special_tokens: bool = True) -> str:
-        specials = {"<pad>", "</s>", "<s>", "<unk>", "<mask>"}
+        # ``skip_special_tokens`` is accepted but inert: a Rust
+        # ByteLevelBPETokenizer loaded from vocab/merges FILES (the
+        # reference's construction, tokenizer.py:42-49) registers no added
+        # special tokens, so its decode renders '<s>'/'</s>'/'<pad>' as
+        # literal text regardless of the flag — fuzz-verified in
+        # tests/test_tokenizer_diff.py.
+        del skip_special_tokens
         text = ""
         for i in ids:
             tok = self.inv_vocab.get(int(i), "<unk>")
-            if skip_special_tokens and tok in specials:
-                continue
             text += tok
+        # No strip: the Rust ByteLevel decoder preserves surrounding
+        # whitespace exactly (fuzz-verified in tests/test_tokenizer_diff.py).
         raw = bytearray(self.byte_decoder.get(ch, ord(" ")) for ch in text)
-        return raw.decode("utf-8", errors="replace").strip()
+        return raw.decode("utf-8", errors="replace")
 
     def token_to_id(self, token: str) -> Optional[int]:
         return self.vocab.get(token)

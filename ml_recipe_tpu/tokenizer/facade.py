@@ -110,7 +110,13 @@ class Tokenizer:
         return self.tokenizer.encode(string)
 
     def decode(self, ids, *, skip_special_tokens: bool = True) -> str:
-        return self.tokenizer.decode(ids, skip_special_tokens=skip_special_tokens)
+        # The trailing ' ##' strip reproduces the reference wrapper's own
+        # decode post-processing (tokenizer.py:61), applied on top of the
+        # backend decode for BOTH models — it is a no-op for WordPiece output
+        # but visibly rewrites byte-BPE decodes whose text contains ' ##'.
+        return self.tokenizer.decode(
+            ids, skip_special_tokens=skip_special_tokens
+        ).replace(" ##", "")
 
     @property
     def pad_token_id(self) -> int:

@@ -175,8 +175,24 @@ class WordPieceTokenizer:
             if skip_special_tokens and tok in specials:
                 continue
             tokens.append(tok)
-        # ``' ##'`` join matches reference tokenizer.py:61 decode semantics.
-        return " ".join(tokens).replace(" ##", "")
+        # Matches the Rust WordPiece decoder with ``cleanup=True`` (the
+        # reference's decode path, tokenizer.py:61): each non-first token is
+        # either a ``##`` continuation (prefix stripped, no space) or gets a
+        # leading space, and the cleanup substitution chain runs PER PIECE —
+        # not on the joined string, so e.g. a lone apostrophe piece " '" is
+        # never collapsed. Fuzz-verified in tests/test_tokenizer_diff.py.
+        pieces = []
+        for idx, tok in enumerate(tokens):
+            if idx != 0:
+                tok = tok[2:] if tok.startswith("##") else " " + tok
+            for dirty, clean in (
+                (" .", "."), (" ?", "?"), (" !", "!"), (" ,", ","),
+                (" ' ", "' "), (" n't", "n't"), (" 'm", "'m"), (" 's", "'s"),
+                (" 've", "'ve"), (" 're", "'re"),
+            ):
+                tok = tok.replace(dirty, clean)
+            pieces.append(tok)
+        return "".join(pieces)
 
     def token_to_id(self, token: str) -> Optional[int]:
         return self.vocab.get(token)

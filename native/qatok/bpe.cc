@@ -203,8 +203,8 @@ inline bool is_ascii_space(unsigned char c) {
   return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' || c == '\v';
 }
 
-inline bool is_letter(unsigned char c) {  // [^\s\d\W] == [A-Za-z_] on ASCII
-  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') || c == '_';
+inline bool is_letter(unsigned char c) {  // \p{L} == [A-Za-z] on ASCII ('_' is punct)
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z');
 }
 
 inline bool is_digit(unsigned char c) { return c >= '0' && c <= '9'; }
@@ -249,16 +249,24 @@ void gpt2_split(const std::string& text,
         break;
       }
       if (j - i > 1) {
-        // run minus one: the last space binds to the following token
+        // run minus one: the last whitespace char binds to the next token
+        // (or stands alone when it is not a literal space)
         pieces->emplace_back(i, j - 1);
         i = j - 1;
         continue;
       }
-      // single space before a visible char: consumed by ` ?X+` below
+      if (c != ' ') {
+        // the ` ?` optional prefix in the regex is a LITERAL space; any
+        // other single whitespace char is its own `\s+` token
+        pieces->emplace_back(i, i + 1);
+        ++i;
+        continue;
+      }
+      // single literal space before a visible char: consumed by ` ?X+` below
     }
 
     size_t start = i;
-    size_t k = i + (is_ascii_space(c) ? 1 : 0);  // optional leading space
+    size_t k = i + (c == ' ' ? 1 : 0);  // optional leading literal space
     unsigned char d = (unsigned char)text[k];
     if (is_letter(d)) {
       while (k < n && is_letter((unsigned char)text[k])) ++k;
