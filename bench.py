@@ -75,7 +75,7 @@ def main() -> None:
     # test-only Trainer skips optimizer construction; build it for the bench
     from ml_recipe_tpu.train.optim import build_optimizer
 
-    trainer.optimizer, trainer.scheduler = build_optimizer(
+    trainer.optimizer, trainer.scheduler, trainer._schedule_count = build_optimizer(
         TP(), trainer.params, num_training_steps=10_000, max_grad_norm=None,
         warmup_coef=0.0,
     )

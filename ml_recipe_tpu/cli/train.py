@@ -23,6 +23,7 @@ import functools
 import logging
 import os
 import signal
+import threading
 from datetime import datetime
 
 from ..compose import init_collate_fun, init_datasets, init_loss, init_model
@@ -138,21 +139,32 @@ def run_worker(params, model_params) -> None:
     def _sigterm_to_interrupt(signum, frame):
         raise KeyboardInterrupt(f"signal {signum}")
 
-    prev_handler = signal.signal(signal.SIGTERM, _sigterm_to_interrupt)
+    # signal.signal raises ValueError off the main thread — an embedding
+    # launcher running run_worker from a worker thread should train without
+    # the SIGTERM hook, not crash before the first step.
+    on_main_thread = threading.current_thread() is threading.main_thread()
+    if on_main_thread:
+        prev_handler = signal.signal(signal.SIGTERM, _sigterm_to_interrupt)
+    else:
+        local_logger.info(
+            "Not on the main thread; SIGTERM-to-checkpoint handler not installed."
+        )
     try:
         trainer.train(after_epoch_funcs=[save_last, save_each, test_fun])
     except KeyboardInterrupt:
         # disarm first: a second SIGTERM during the (multi-second) save must
         # not re-raise inside save_state_dict and abort the very checkpoint
         # this path exists to produce
-        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        if on_main_thread:
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
         local_logger.error("Training process was interrupted.")
         trainer.save_state_dict(params.dump_dir / params.experiment_name / "interrupt.ch")
     except Exception as e:
         local_logger.error(e)
         raise e
     finally:
-        signal.signal(signal.SIGTERM, prev_handler)
+        if on_main_thread:
+            signal.signal(signal.SIGTERM, prev_handler)
 
 
 def main(params, model_params) -> None:

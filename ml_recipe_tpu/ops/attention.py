@@ -93,27 +93,38 @@ def dot_product_attention(
             batch_axis=batch_axis, dtype=dtype,
         )
 
-    if impl == "auto":
-        from .flash_attention import _pick_q_block, supports_fused_bwd
-
-        L = q.shape[1]
-        use_pallas = jax.default_backend() == "tpu" and (
-            # dropout lives inside the fully-fused kernel only
-            supports_fused_bwd(L)
-            if dropout_rate > 0.0
-            else _pick_q_block(L) is not None
+    if impl in ("auto", "pallas"):
+        from .flash_attention import (
+            supports_blocked_bwd, supports_blocked_fwd, supports_fused_bwd,
         )
+
+        L, H, D = q.shape[1], q.shape[2], q.shape[3]
+        in_isz = jnp.dtype(q.dtype).itemsize
+        out_isz = jnp.dtype(dtype).itemsize
+        # Dropout needs BOTH kernel directions feasible: the forward's
+        # in-kernel mask cannot be reproduced by an XLA fallback backward.
+        blocked_ok = supports_blocked_fwd(
+            L, H, D, in_isz, out_isz, dropout_rate
+        ) and (
+            dropout_rate == 0.0
+            or supports_blocked_bwd(L, H, D, in_isz, dropout_rate)
+        )
+        shapes_ok = supports_fused_bwd(L) or blocked_ok
+
+    if impl == "auto":
+        use_pallas = jax.default_backend() == "tpu" and shapes_ok
         impl = "pallas" if use_pallas else "xla"
 
     if impl == "pallas":
-        from .flash_attention import flash_attention, supports_fused_bwd
+        from .flash_attention import flash_attention
 
-        if dropout_rate > 0.0 and not supports_fused_bwd(q.shape[1]):
+        if not shapes_ok:
             import logging
 
             logging.getLogger(__name__).warning(
-                "Pallas fused attention supports dropout only at L <= 512; "
-                "using XLA attention so attention-dropout is preserved."
+                f"Pallas fused attention has no VMEM-feasible kernel config "
+                f"for L={L}, H={H}, D={D}, rate={dropout_rate}; using XLA "
+                f"attention instead."
             )
         else:
             seed = None

@@ -16,13 +16,17 @@ Three regimes:
   head-group) computes whole heads in VMEM, forward and backward, with
   optional attention-probs dropout applied INSIDE the kernel. This covers
   the reference's training shape (max_seq_len <= 512, config/test_bert.cfg:66).
-- larger L (VMEM-feasible, no dropout — ~2k at bf16/D=64): q-blocked
-  forward AND backward kernels. The whole per-head-group K/V stays
+- larger L (VMEM-feasible — ~2k at bf16/D=64): q-blocked forward AND
+  backward kernels, dropout included. The whole per-head-group K/V stays
   VMEM-resident, so each q-block program computes the exact full-row
   softmax (no lse residuals) and dk/dv accumulate in f32 across the q
   sweep in revisited output blocks — the [B, H, L, L] score tensor never
-  exists in HBM in either direction. ``_blocked_bwd_cfg`` decides
-  feasibility; infeasible shapes fall back to the XLA-recompute backward.
+  exists in HBM in either direction. ``_blocked_fwd_cfg`` /
+  ``_blocked_bwd_cfg`` decide feasibility (shrinking the q-block before
+  declining); infeasible backward shapes fall back to the XLA-recompute
+  backward (rate == 0 only — a dropout forward's mask cannot be
+  reproduced outside the kernels, so the dispatcher requires BOTH
+  directions feasible before enabling dropout here).
 - anything else: the dispatcher (ops/attention.py) uses the XLA path.
 
 Dropout determinism: the backward must regenerate the exact forward mask. The
@@ -53,12 +57,18 @@ _NEG_INF = -1e30
 _FUSED_BWD_MAX_LEN = 512
 
 
-def _uniform_grid(seed, bh, L: int):
-    """[L, L] uniform floats in [0, 1) from a murmur3-finalizer hash of
-    (seed, batch*heads+head, flat index). Plain int32 vector ops only."""
-    rows = jax.lax.broadcasted_iota(jnp.int32, (L, L), 0)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (L, L), 1)
-    x = rows * jnp.int32(L) + cols
+def _uniform_grid(seed, bh, L: int, rows: Optional[int] = None, row_offset=0):
+    """[rows, L] uniform floats in [0, 1) from a murmur3-finalizer hash of
+    (seed, batch*heads+head, flat index). Plain int32 vector ops only.
+    ``rows``/``row_offset`` select a q-block slice of the full [L, L] grid:
+    the bits depend only on the ABSOLUTE row index, so the q-blocked kernels
+    regenerate exactly the mask the fused kernels would (and the backward
+    regenerates the forward's regardless of either side's block size)."""
+    if rows is None:
+        rows = L
+    r = jax.lax.broadcasted_iota(jnp.int32, (rows, L), 0) + row_offset
+    cols = jax.lax.broadcasted_iota(jnp.int32, (rows, L), 1)
+    x = r * jnp.int32(L) + cols
     x = x ^ (seed + bh * jnp.int32(-1640531527))  # 2654435761 as int32
     x = x * jnp.int32(-862048943)   # 0xCC9E2D51
     x = x ^ ((x >> 16) & jnp.int32(0xFFFF))
@@ -185,9 +195,10 @@ def _fused_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
         dv_ref[0, :, sl] = dv.astype(dv_ref.dtype)
 
 
-def _blocked_bwd_kernel(mask_ref, q_ref, k_ref, v_ref, g_ref,
+def _blocked_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
                         dq_ref, dk_ref, dv_ref,
-                        *, scale: float, hc: int, D: int):
+                        *, scale: float, rate: float, heads: int, hc: int,
+                        D: int):
     """Fused long-sequence backward: one (batch, head-group, q-block)
     program. The whole K/V for the head group stays resident in VMEM, so
     each program computes the EXACT full-row softmax for its q rows (no
@@ -195,18 +206,30 @@ def _blocked_bwd_kernel(mask_ref, q_ref, k_ref, v_ref, g_ref,
     dq writes its own q-block; dk/dv accumulate in f32 into output blocks
     whose index map is constant in the q-block dimension — Pallas keeps
     them resident across the q sweep and writes back once per (b, hj).
-    No dropout in this regime (dispatcher guarantees rate == 0)."""
-    qi = pl.program_id(2)
+    Dropout (``rate > 0``) regenerates the forward's keep-mask from the
+    absolute row indices of this q-block."""
+    b, hj, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
 
     mask = mask_ref[0, 0, :]
+    L = k_ref.shape[1]
+    q_blk = q_ref.shape[1]
     for h in range(hc):
         sl = slice(h * D, (h + 1) * D)
+
+        drop = None
+        if rate > 0.0:
+            keep = _uniform_grid(
+                seed_ref[0], b * heads + hj * hc + h, L,
+                rows=q_blk, row_offset=qi * q_blk,
+            ) >= rate
+            drop = (keep, jnp.float32(1.0 / (1.0 - rate)))
+
         dq, dk, dv = _attention_bwd_math(
             q_ref[0, :, sl],   # [q_blk, D]
             k_ref[0, :, sl],   # [L, D] (whole)
             v_ref[0, :, sl],   # [L, D] (whole)
             g_ref[0, :, sl],   # [q_blk, D]
-            mask, scale,
+            mask, scale, drop=drop,
         )
 
         dq_ref[0, :, sl] = dq.astype(dq_ref.dtype)
@@ -222,17 +245,28 @@ def _blocked_bwd_kernel(mask_ref, q_ref, k_ref, v_ref, g_ref,
             dv_ref[0, :, sl] += dv
 
 
-def _blocked_fwd_kernel(mask_ref, q_ref, k_ref, v_ref, o_ref,
-                        *, scale: float, hc: int, D: int):
-    """One (batch, q-block, head-group) program for longer sequences
-    (no dropout)."""
+def _blocked_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, o_ref,
+                        *, scale: float, rate: float, heads: int, hc: int,
+                        D: int):
+    """One (batch, head-group, q-block) program for longer sequences, with
+    optional in-kernel attention-probs dropout (keep-bits keyed by the
+    absolute row index so the backward regenerates the same mask)."""
+    b, hj, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     mask = mask_ref[0, 0, :]
+    L = k_ref.shape[1]
+    q_blk = q_ref.shape[1]
     for h in range(hc):
         sl = slice(h * D, (h + 1) * D)
         q = q_ref[0, :, sl]
         k = k_ref[0, :, sl]
         v = v_ref[0, :, sl]
         p = _softmax_probs(q, k, mask, scale)
+        if rate > 0.0:
+            u = _uniform_grid(
+                seed_ref[0], b * heads + hj * hc + h, L,
+                rows=q_blk, row_offset=qi * q_blk,
+            )
+            p = jnp.where(u >= rate, p * (1.0 / (1.0 - rate)), 0.0)
         o = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -264,20 +298,25 @@ def _fold(x):
 _VMEM_BUDGET = 12 * 1024 * 1024  # leave ~4 MB of the ~16 MB/core for Mosaic
 
 
-def _pick_head_chunk(H: int, D: int, bytes_per_head: int,
-                     temp_bytes: int) -> int:
-    """Largest LEGAL divisor of H whose per-head-group block bytes plus the
-    fixed temporaries fit the VMEM budget. Legal means the block's lane dim
-    (hc*D) is 128-divisible or spans the whole folded array (Mosaic rejects
-    other widths — hc=3 with D=64 gives 192 lanes and fails to lower).
-    Callers compute ``bytes_per_head`` from their own block geometry and
-    dtypes (x2 for Mosaic double-buffering) and ``temp_bytes`` from their
-    per-head f32 working set. Falls back to the smallest legal chunk when
-    nothing fits the budget (best effort — Mosaic may still OOM loudly)."""
-    legal = [
+def _legal_head_chunks(H: int, D: int):
+    """Divisors of H whose lane width (hc*D) is 128-divisible or spans the
+    whole folded array (Mosaic rejects other block widths — hc=3 with D=64
+    gives 192 lanes and fails to lower)."""
+    return [
         d for d in range(1, H + 1)
         if H % d == 0 and ((d * D) % 128 == 0 or d == H)
     ]
+
+
+def _pick_head_chunk(H: int, D: int, bytes_per_head: int,
+                     temp_bytes: int) -> int:
+    """Largest legal divisor of H whose per-head-group block bytes plus the
+    fixed temporaries fit the VMEM budget. Callers compute
+    ``bytes_per_head`` from their own block geometry and dtypes (x2 for
+    Mosaic double-buffering) and ``temp_bytes`` from their per-head f32
+    working set. Falls back to the smallest legal chunk when nothing fits
+    the budget (best effort — Mosaic may still OOM loudly)."""
+    legal = _legal_head_chunks(H, D)
     for hc in sorted(legal, reverse=True):
         if bytes_per_head * hc + temp_bytes <= _VMEM_BUDGET:
             return hc
@@ -339,60 +378,92 @@ def _flash_backward(q, k, v, mask, seed, g, dtype, rate, interpret: bool):
     return tuple(x.reshape(B, L, H, D) for x in (dq, dk, dv))
 
 
-def _blocked_forward(q, k, v, mask, dtype, interpret: bool):
-    B, L, H, D = q.shape
+def _blocked_fwd_cfg(L: int, H: int, D: int, in_itemsize: int,
+                     out_itemsize: int, rate: float = 0.0):
+    """(q_blk, hc) for the q-blocked forward, or ``None`` when no
+    configuration fits the VMEM budget (the dispatcher then routes to the
+    XLA path instead of letting Mosaic OOM on hardware — interpret-mode
+    tests cannot catch a real VMEM overflow).
+
+    Working set per program: [q_blk, L] f32 temporaries (scores, probs,
+    softmax scratch, + the dropout uniform grid when ``rate > 0``); blocks:
+    q at q_blk rows and k/v at L rows (input dtype), o at q_blk rows
+    (output dtype), all double-buffered."""
     q_blk = _pick_q_block(L)
-    assert q_blk is not None, f"unsupported sequence length {L}"
-    # blocks: k/v carry L rows, q/o only q_blk; temporaries are [q_blk, L]
-    hc = _pick_head_chunk(
-        H, D,
-        bytes_per_head=2 * D * (
-            (2 * L + q_blk) * q.dtype.itemsize
-            + q_blk * jnp.dtype(dtype).itemsize
-        ),
-        temp_bytes=3 * q_blk * L * 4,
+    if q_blk is None:
+        return None
+    n_temps = 3 + (1 if rate > 0.0 else 0)
+    while q_blk > 128 and n_temps * q_blk * L * 4 > _VMEM_BUDGET // 2:
+        q_blk //= 2
+    temp_bytes = n_temps * q_blk * L * 4
+    for hc in sorted(_legal_head_chunks(H, D), reverse=True):
+        block_bytes = hc * D * 2 * (
+            (2 * L + q_blk) * in_itemsize + q_blk * out_itemsize
+        )
+        if block_bytes + temp_bytes <= _VMEM_BUDGET:
+            return q_blk, hc
+    return None
+
+
+def supports_blocked_fwd(L: int, H: int, D: int, in_itemsize: int,
+                         out_itemsize: int, rate: float = 0.0) -> bool:
+    """True when the q-blocked forward has a VMEM-feasible configuration
+    for this exact shape/dtype geometry (no defaults: a bert-base answer
+    for a different geometry would be silently wrong)."""
+    return (
+        L > _FUSED_BWD_MAX_LEN
+        and _blocked_fwd_cfg(L, H, D, in_itemsize, out_itemsize, rate)
+        is not None
     )
+
+
+def _blocked_forward(q, k, v, mask, seed, q_blk, hc, dtype, rate,
+                     interpret: bool):
+    B, L, H, D = q.shape
 
     # q-blocks INNERMOST: the k/v index map is constant in qi, so Pallas
     # keeps each head-group's full K/V resident across all q-blocks instead
     # of re-streaming them L/q_blk times from HBM.
     out = pl.pallas_call(
         functools.partial(_blocked_fwd_kernel, scale=1.0 / (D ** 0.5),
-                          hc=hc, D=D),
-        grid=(B, H // hc, L // q_blk),
-        in_specs=[
-            pl.BlockSpec((1, 1, L), lambda b, hj, qi: (b, 0, 0)),            # mask
-            pl.BlockSpec((1, q_blk, hc * D), lambda b, hj, qi: (b, qi, hj)),  # q
-            pl.BlockSpec((1, L, hc * D), lambda b, hj, qi: (b, 0, hj)),       # k
-            pl.BlockSpec((1, L, hc * D), lambda b, hj, qi: (b, 0, hj)),       # v
-        ],
-        out_specs=pl.BlockSpec((1, q_blk, hc * D), lambda b, hj, qi: (b, qi, hj)),
+                          rate=rate, heads=H, hc=hc, D=D),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H // hc, L // q_blk),
+            in_specs=[
+                pl.BlockSpec((1, 1, L), lambda b, hj, qi, *_: (b, 0, 0)),            # mask
+                pl.BlockSpec((1, q_blk, hc * D), lambda b, hj, qi, *_: (b, qi, hj)),  # q
+                pl.BlockSpec((1, L, hc * D), lambda b, hj, qi, *_: (b, 0, hj)),       # k
+                pl.BlockSpec((1, L, hc * D), lambda b, hj, qi, *_: (b, 0, hj)),       # v
+            ],
+            out_specs=pl.BlockSpec(
+                (1, q_blk, hc * D), lambda b, hj, qi, *_: (b, qi, hj)
+            ),
+        ),
         out_shape=jax.ShapeDtypeStruct((B, L, H * D), dtype),
         interpret=interpret,
-    )(mask[:, None, :], _fold(q), _fold(k), _fold(v))
+    )(seed, mask[:, None, :], _fold(q), _fold(k), _fold(v))
     return out.reshape(B, L, H, D)
 
 
-def _blocked_bwd_cfg(L: int, H: int, D: int, in_itemsize: int):
+def _blocked_bwd_cfg(L: int, H: int, D: int, in_itemsize: int,
+                     rate: float = 0.0):
     """(q_blk, hc) for the fused q-blocked backward, or ``None`` when no
     configuration fits the VMEM budget (the caller then falls back to the
     XLA-recompute backward instead of letting Mosaic OOM on hardware).
 
     Working set per program: [q_blk, L] f32 temporaries (p, dp, ds + softmax
-    scratch, ~4 deep); blocks: q/g/dq at q_blk rows and k/v at L rows
-    (input dtype, double-buffered), dk/dv at L rows in f32 (revisited
-    accumulators, not double-buffered)."""
+    scratch, ~4 deep, + the dropout keep grid when ``rate > 0``); blocks:
+    q/g/dq at q_blk rows and k/v at L rows (input dtype, double-buffered),
+    dk/dv at L rows in f32 (revisited accumulators, not double-buffered)."""
     q_blk = _pick_q_block(L)
     if q_blk is None:
         return None
-    while q_blk > 128 and 4 * q_blk * L * 4 > _VMEM_BUDGET // 2:
+    n_temps = 4 + (1 if rate > 0.0 else 0)
+    while q_blk > 128 and n_temps * q_blk * L * 4 > _VMEM_BUDGET // 2:
         q_blk //= 2
-    temp_bytes = 4 * q_blk * L * 4
-    legal = [
-        d for d in range(1, H + 1)
-        if H % d == 0 and ((d * D) % 128 == 0 or d == H)
-    ]
-    for hc in sorted(legal, reverse=True):
+    temp_bytes = n_temps * q_blk * L * 4
+    for hc in sorted(_legal_head_chunks(H, D), reverse=True):
         block_bytes = hc * D * (
             2 * (2 * L + 3 * q_blk) * in_itemsize + 2 * L * 4
         )
@@ -401,40 +472,46 @@ def _blocked_bwd_cfg(L: int, H: int, D: int, in_itemsize: int):
     return None
 
 
-def supports_blocked_bwd(L: int, H: int = 12, D: int = 64,
-                         in_itemsize: int = 2) -> bool:
-    """True when the fused q-blocked backward applies (no dropout) AND a
-    VMEM-feasible configuration exists for the given head geometry."""
+def supports_blocked_bwd(L: int, H: int, D: int, in_itemsize: int,
+                         rate: float = 0.0) -> bool:
+    """True when the fused q-blocked backward has a VMEM-feasible
+    configuration for this exact head geometry and input itemsize (no
+    defaults: a bert-base answer for a different geometry would be
+    silently wrong)."""
     return (
         L > _FUSED_BWD_MAX_LEN
-        and _blocked_bwd_cfg(L, H, D, in_itemsize) is not None
+        and _blocked_bwd_cfg(L, H, D, in_itemsize, rate) is not None
     )
 
 
-def _blocked_backward(q, k, v, mask, g, q_blk, hc, dtype, interpret: bool):
+def _blocked_backward(q, k, v, mask, seed, g, q_blk, hc, dtype, rate,
+                      interpret: bool):
     B, L, H, D = q.shape
 
-    spec_q = pl.BlockSpec((1, q_blk, hc * D), lambda b, hj, qi: (b, qi, hj))
-    spec_l = pl.BlockSpec((1, L, hc * D), lambda b, hj, qi: (b, 0, hj))
+    spec_q = pl.BlockSpec((1, q_blk, hc * D), lambda b, hj, qi, *_: (b, qi, hj))
+    spec_l = pl.BlockSpec((1, L, hc * D), lambda b, hj, qi, *_: (b, 0, hj))
 
     dq, dk, dv = pl.pallas_call(
         functools.partial(_blocked_bwd_kernel, scale=1.0 / (D ** 0.5),
-                          hc=hc, D=D),
-        grid=(B, H // hc, L // q_blk),
-        in_specs=[
-            pl.BlockSpec((1, 1, L), lambda b, hj, qi: (b, 0, 0)),  # mask
-            spec_q,                                                # q block
-            spec_l, spec_l,                                        # k v whole
-            spec_q,                                                # g block
-        ],
-        out_specs=[spec_q, spec_l, spec_l],
+                          rate=rate, heads=H, hc=hc, D=D),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H // hc, L // q_blk),
+            in_specs=[
+                pl.BlockSpec((1, 1, L), lambda b, hj, qi, *_: (b, 0, 0)),  # mask
+                spec_q,                                                # q block
+                spec_l, spec_l,                                        # k v whole
+                spec_q,                                                # g block
+            ],
+            out_specs=[spec_q, spec_l, spec_l],
+        ),
         out_shape=[
             jax.ShapeDtypeStruct((B, L, H * D), q.dtype),      # dq
             jax.ShapeDtypeStruct((B, L, H * D), jnp.float32),  # dk (f32 acc)
             jax.ShapeDtypeStruct((B, L, H * D), jnp.float32),  # dv (f32 acc)
         ],
         interpret=interpret,
-    )(mask[:, None, :], _fold(q), _fold(k), _fold(v), _fold(g))
+    )(seed, mask[:, None, :], _fold(q), _fold(k), _fold(v), _fold(g))
     return (
         dq.reshape(B, L, H, D),
         dk.reshape(B, L, H, D).astype(k.dtype),
@@ -452,11 +529,19 @@ def _xla_reference(q, k, v, mask, dtype):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
 def _flash_core(q, k, v, mask, seed, dtype, rate, interpret):
-    L = q.shape[1]
+    B, L, H, D = q.shape
     if supports_fused_bwd(L):
         return _flash_forward(q, k, v, mask, seed, dtype, rate, interpret)
-    assert rate == 0.0, "dropout requires the fully-fused regime (L <= 512)"
-    return _blocked_forward(q, k, v, mask, dtype, interpret)
+    cfg = _blocked_fwd_cfg(
+        L, H, D, q.dtype.itemsize, jnp.dtype(dtype).itemsize, rate
+    )
+    if cfg is None:
+        raise ValueError(
+            f"no VMEM-feasible blocked-forward config for L={L}, H={H}, "
+            f"D={D} (rate={rate}); route this shape to the XLA path "
+            f"(supports_blocked_fwd is the dispatcher's gate)"
+        )
+    return _blocked_forward(q, k, v, mask, seed, *cfg, dtype, rate, interpret)
 
 
 def _fwd(q, k, v, mask, seed, dtype, rate, interpret):
@@ -474,12 +559,21 @@ def _bwd(dtype, rate, interpret, residuals, g):
         return dq, dk, dv, None, None
     if L > _FUSED_BWD_MAX_LEN:
         H, D = q.shape[2], q.shape[3]
-        cfg = _blocked_bwd_cfg(L, H, D, q.dtype.itemsize)
+        cfg = _blocked_bwd_cfg(L, H, D, q.dtype.itemsize, rate)
         if cfg is not None:
             dq, dk, dv = _blocked_backward(
-                q, k, v, mask, g.astype(q.dtype), *cfg, dtype, interpret
+                q, k, v, mask, seed, g.astype(q.dtype), *cfg, dtype, rate,
+                interpret,
             )
             return dq, dk, dv, None, None
+    if rate > 0.0:
+        # The forward applied the in-kernel dropout mask; an XLA-recompute
+        # backward cannot reproduce it. The dispatcher gates dropout on
+        # supports_blocked_bwd, so this is unreachable through it.
+        raise ValueError(
+            f"no VMEM-feasible blocked-backward config for L={L}, H={H}, "
+            f"D={D} with dropout; gate on supports_blocked_bwd"
+        )
     _, vjp = jax.vjp(
         lambda q_, k_, v_: _xla_reference(q_, k_, v_, mask, dtype), q, k, v
     )
@@ -496,7 +590,12 @@ def flash_attention(q, k, v, mask, seed=None, dtype=jnp.float32, rate=0.0,
 
     ``seed``: int32 array of shape (1,) keying the in-kernel dropout mask
     (ignored when ``rate == 0``). ``rate``: attention-probs dropout rate —
-    requires the fully-fused regime (``supports_fused_bwd(L)``).
+    supported by the fully-fused regime (L <= 512) and by the q-blocked
+    regime when BOTH directions have a VMEM-feasible config
+    (``supports_blocked_fwd``/``supports_blocked_bwd``); raises ValueError
+    for shapes with no feasible kernel config (the dispatcher in
+    ops/attention.py gates on the ``supports_*`` predicates and routes such
+    shapes to the XLA path instead).
     """
     if mask is None:
         mask = jnp.ones(q.shape[:2], dtype=jnp.int32)

@@ -135,11 +135,11 @@ class SaveBestCallback(TestCallback):
                 self.params.dump_dir / self.params.experiment_name / "best.ch"
             )
             logger.info(
-                f"Best value of {self.metric} was achieved after training step "
-                f"{trainer.global_step} and equals to {self.value:.3f}"
+                f"New best {self.metric}={self.value:.3f} at global step "
+                f"{trainer.global_step}; wrote best.ch"
             )
         else:
             logger.info(
-                f"Best value {self.value:.3f} of {self.metric} was not bitten "
-                f"with {value:.3f}"
+                f"{self.metric}={value:.3f} did not beat the current best "
+                f"{self.value:.3f}; best.ch unchanged"
             )

@@ -142,7 +142,20 @@ def load_state_dict(
                 # slot holds no values — wrap the legacy state as slot "0"
                 # and take slot "1" from the freshly initialized target.
                 target_sd = serialization.to_state_dict(opt_state)
-                if isinstance(target_sd, dict) and set(target_sd.keys()) == {"0", "1"}:
+                # Only a genuine fine-tune chain qualifies: slot "1" must be
+                # the empty masked(set_to_zero) state (no leaves). Any other
+                # 2-element chain means a real mismatch — re-raise it rather
+                # than silently mis-wrapping the saved state into slot 0.
+                def _leafless(node):
+                    if isinstance(node, dict):
+                        return all(_leafless(v) for v in node.values())
+                    return False
+
+                if (
+                    isinstance(target_sd, dict)
+                    and set(target_sd.keys()) == {"0", "1"}
+                    and _leafless(target_sd["1"])
+                ):
                     wrapped = {"0": migrated, "1": target_sd["1"]}
                     new_opt_state = serialization.from_state_dict(opt_state, wrapped)
                     logger.info(

@@ -207,9 +207,15 @@ def build_optimizer(
     """Optimizer selection + schedule (reference init.py:134-145 +
     trainer.py:116-126 + clip trainer.py:221-225 fused into one chain).
 
-    Returns ``(optax transform, schedule_fn)``. ``warmup_coef``, when given,
-    overrides ``trainer_params.warmup_coef`` (the Trainer field is the single
-    source of truth when built through the Trainer).
+    Returns ``(optax transform, schedule_fn, schedule_count_fn)``.
+    ``schedule_count_fn(opt_state)`` reads the schedule step count out of the
+    transform's own state, structurally — built here, where the chain layout
+    is decided, so no caller ever scans the state tree by leaf name. The
+    count only advances on APPLIED updates, which is what makes it the right
+    schedule index under loss scaling (overflow steps freeze the whole
+    state, count included). ``warmup_coef``, when given, overrides
+    ``trainer_params.warmup_coef`` (the Trainer field is the single source
+    of truth when built through the Trainer).
     """
     if warmup_coef is None:
         warmup_coef = getattr(trainer_params, "warmup_coef", 0.0)
@@ -237,13 +243,25 @@ def build_optimizer(
             decay_mask=decay_mask,
         )
 
+    is_adam = getattr(trainer_params, "optimizer", "adam") == "adam"
+    has_clip = max_grad_norm is not None and max_grad_norm > 0
+
     chain = [core]
-    if max_grad_norm is not None and max_grad_norm > 0:
+    if has_clip:
         chain.insert(0, optax.clip_by_global_norm(max_grad_norm))
 
     tx = optax.chain(*chain)
 
     tmask = trainable_mask(params, trainer_params)
+
+    def schedule_count_fn(opt_state):
+        s = opt_state
+        if tmask is not None:
+            s = s[0].inner_state  # masked(tx) wrapper, chain slot 0
+        s = s[1] if has_clip else s[0]  # `core`'s slot in the outer chain
+        if is_adam:
+            s = s[0]  # core = chain(adam_moments, decay, lr)
+        return s.count  # ScaleByAdamState / AdaModState
     if tmask is not None:
         # optax.masked passes NON-masked updates through UNCHANGED — i.e. the
         # frozen leaves would come out as their raw gradients and be added to
@@ -255,4 +273,4 @@ def build_optimizer(
             optax.masked(tx, tmask), optax.masked(optax.set_to_zero(), frozen)
         )
 
-    return tx, schedule
+    return tx, schedule, schedule_count_fn

@@ -189,6 +189,7 @@ class Trainer:
         self.optimizer = None
         self.opt_state = None
         self.scheduler = None
+        self._schedule_count = None
         self._zero_shardings = None
         self._use_loss_scale = False
         if self.train_dataloader is not None and self.trainer_params is not None:
@@ -214,7 +215,7 @@ class Trainer:
             # clipping happens in the train step on the FLAT gradient vector
             # (one fused kernel; optax.clip_by_global_norm costs ~2 launches
             # per parameter tensor) — so the chain is built without it
-            self.optimizer, self.scheduler = build_optimizer(
+            self.optimizer, self.scheduler, self._schedule_count = build_optimizer(
                 self.trainer_params,
                 self.params,
                 num_training_steps=num_training_steps,
@@ -336,6 +337,7 @@ class Trainer:
         model, loss, optimizer = self.model, self.loss, self.optimizer
         batch_split = self.batch_split
         schedule = self.scheduler
+        schedule_count = self._schedule_count
         use_ls = self._use_loss_scale
         # the optimizer chain is built without clip_by_global_norm — the step
         # clips the flat gradient vector itself whenever max_grad_norm is set
@@ -516,13 +518,8 @@ class Trainer:
             # read the actual count out of the incoming optimizer state.
             if schedule is None:
                 values["lr"] = jnp.float32(0)
-            elif use_ls:
-                counts = [
-                    leaf
-                    for path, leaf in jax.tree_util.tree_flatten_with_path(opt_state)[0]
-                    if path and getattr(path[-1], "name", None) == "count"
-                ]
-                values["lr"] = schedule(counts[0] if counts else step)
+            elif use_ls and schedule_count is not None:
+                values["lr"] = schedule(schedule_count(opt_state))
             else:
                 values["lr"] = schedule(step)
 

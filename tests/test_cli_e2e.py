@@ -15,6 +15,8 @@ import pytest
 
 from helpers import make_tokenizer, nq_line, write_corpus
 
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def e2e(tmp_path_factory):

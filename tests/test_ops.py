@@ -222,7 +222,8 @@ def test_blocked_bwd_long_sequence_matches_xla():
     )
 
     B, L, H, D = 2, 1024, 4, 32
-    assert not supports_fused_bwd(L) and supports_blocked_bwd(L)
+    assert not supports_fused_bwd(L)
+    assert supports_blocked_bwd(L, H, D, in_itemsize=4)
     rng = np.random.default_rng(0)
     q, k, v = (jnp.asarray(rng.normal(size=(B, L, H, D)), jnp.float32)
                for _ in range(3))
@@ -265,3 +266,87 @@ def test_blocked_bwd_cfg_feasibility():
     assert _blocked_bwd_cfg(3072, 12, 64, 2) is None
     # f32 inputs double the block bytes -> declines earlier
     assert _blocked_bwd_cfg(2048, 12, 64, 4) is None or True  # just must not crash
+
+
+def test_blocked_fwd_cfg_feasibility():
+    """The forward mirrors the backward's feasibility gate (ADVICE r1: the
+    old forward routed ANY 128-divisible L to Pallas and could VMEM-OOM on
+    hardware at L >= 2048)."""
+    from ml_recipe_tpu.ops.flash_attention import (
+        _blocked_fwd_cfg, supports_blocked_fwd,
+    )
+
+    for L in (1024, 2048):
+        cfg = _blocked_fwd_cfg(L, 12, 64, 2, 2)
+        assert cfg is not None, L
+        q_blk, hc = cfg
+        assert L % q_blk == 0 and 12 % hc == 0
+        assert (hc * 64) % 128 == 0
+        # temporaries alone must fit half the budget after q_blk shrinking
+        assert 3 * q_blk * L * 4 <= 6 * 1024 * 1024
+    # infeasible shapes decline instead of letting Mosaic OOM
+    assert _blocked_fwd_cfg(8192, 12, 64, 4, 4) is None
+    assert not supports_blocked_fwd(8192, 12, 64, 4, 4)
+    # the gate is length-scoped: fused regime owns L <= 512
+    assert not supports_blocked_fwd(512, 12, 64, 2, 2)
+    # dropout adds a [q_blk, L] grid to the working set; still feasible at 1k
+    assert supports_blocked_fwd(1024, 12, 64, 2, 2, rate=0.1)
+
+
+def test_blocked_dropout_long_sequence():
+    """L=1024 + dropout runs fully fused (q-blocked fwd AND bwd): the bwd
+    must regenerate the forward's keep-mask, so for a fixed seed the
+    analytic vjp must match a finite-difference directional derivative
+    (same scheme as the L<=512 fused check above)."""
+    from ml_recipe_tpu.ops.flash_attention import (
+        supports_blocked_bwd, supports_blocked_fwd, supports_fused_bwd,
+    )
+
+    B, L, H, D = 1, 1024, 4, 32
+    assert not supports_fused_bwd(L)
+    assert supports_blocked_fwd(L, H, D, 4, 4, rate=0.3)
+    assert supports_blocked_bwd(L, H, D, 4, rate=0.3)
+
+    q, k, v, mask = _qkv(B=B, L=L, H=H, D=D, seed=7)
+    seed = jnp.asarray([123], jnp.int32)
+
+    out = flash_attention(q, k, v, mask, seed=seed, dtype=jnp.float32,
+                          rate=0.3, interpret=True)
+    out2 = flash_attention(q, k, v, mask, seed=seed, dtype=jnp.float32,
+                           rate=0.3, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+    out3 = flash_attention(q, k, v, mask, seed=jnp.asarray([124], jnp.int32),
+                           dtype=jnp.float32, rate=0.3, interpret=True)
+    assert not np.allclose(np.asarray(out), np.asarray(out3))
+
+    rng = np.random.default_rng(13)
+    w = jnp.asarray(rng.normal(size=q.shape), jnp.float32)
+    dv = jnp.asarray(rng.normal(size=v.shape), jnp.float32)
+
+    def f(v_):
+        o = flash_attention(q, k, v_, mask, seed=seed, dtype=jnp.float32,
+                            rate=0.3, interpret=True)
+        return jnp.sum(o * w)
+
+    g = jax.grad(f)(v)
+    analytic = float(jnp.sum(g * dv))
+    eps = 1e-3
+    numeric = float((f(v + eps * dv) - f(v - eps * dv)) / (2 * eps))
+    assert abs(analytic - numeric) < 1e-2 * max(1.0, abs(numeric))
+
+
+def test_blocked_dropout_expectation_matches_no_dropout():
+    """Inverted dropout in the q-blocked kernel: averaging over seeds
+    approaches the no-dropout output (catches a wrong q-block row offset in
+    the keep-mask, which determinism checks alone would miss)."""
+    q, k, v, mask = _qkv(B=2, L=1024, H=2, D=64, seed=21)
+    base = flash_attention(q, k, v, mask, dtype=jnp.float32, interpret=True)
+    outs = [
+        flash_attention(q, k, v, mask, seed=jnp.asarray([s], jnp.int32),
+                        dtype=jnp.float32, rate=0.2, interpret=True)
+        for s in range(8)
+    ]
+    avg = np.mean([np.asarray(o) for o in outs], axis=0)
+    assert np.abs(avg - np.asarray(base)).mean() < (
+        0.05 * np.abs(np.asarray(base)).mean() + 0.05
+    )

@@ -453,7 +453,7 @@ def test_legacy_clip_chain_checkpoint_loads(tmp_path):
 
     # forge a legacy checkpoint: same trained params, optimizer state saved
     # under the OLD chain structure (clip EmptyState + core)
-    legacy_tx, _ = build_optimizer(
+    legacy_tx, _, _ = build_optimizer(
         TP(), t.params, num_training_steps=4, max_grad_norm=1.0,
         warmup_coef=TP.warmup_coef,
     )
