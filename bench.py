@@ -11,6 +11,12 @@ Prints ONE JSON line:
 ``vs_baseline`` is relative to a nominal single-V100 bert-base fine-tune
 throughput (~100 ex/s at seq 384-512, fp16 — the reference publishes no
 numbers, BASELINE.md:5; the driver's north star is >=3x single-V100).
+
+``--mode infer`` benchmarks the OTHER hot loop (reference
+predictor.py:106-131 + list_dataloader.py): chunks/sec through the real
+inference path — ChunkDataset expansion in ListDataloader worker threads
+(tokenization included), fixed-shape batching, the jitted forward with the
+in-jit 1901.08634 answerability score, and the one-step-lag host gather.
 """
 
 from __future__ import annotations
@@ -23,10 +29,139 @@ import time
 import numpy as np
 
 V100_EXAMPLES_PER_SEC_EST = 100.0  # nominal single-V100 bert-base QA fine-tune
+# nominal single-V100 bert-base fp16 INFERENCE, ~3x its fine-tune rate (no
+# backward, no optimizer) — same provenance caveat as the train estimate
+V100_INFER_CHUNKS_PER_SEC_EST = 300.0
+
+
+def bench_infer(args) -> None:
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    import jax
+    import jax.numpy as jnp
+
+    from ml_recipe_tpu.compose import init_collate_fun
+    from ml_recipe_tpu.data import RawPreprocessor
+    from ml_recipe_tpu.data.datasets import ChunkDataset
+    from ml_recipe_tpu.infer import Predictor
+    from ml_recipe_tpu.models import MODEL_PRESETS, QAModel
+    from ml_recipe_tpu.parallel import build_mesh
+    from ml_recipe_tpu.tokenizer import Tokenizer
+
+    n_chips = len(jax.devices())
+    mesh = build_mesh()
+    L = args.seq_len
+
+    # synthetic NQ-schema corpus: long documents -> several chunks each
+    tmp = Path(tempfile.mkdtemp(prefix="bench_infer_"))
+    try:
+        words = [f"word{i:03d}" for i in range(256)]
+        (tmp / "vocab.txt").write_text(
+            "\n".join(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+                       "<p>", "</p>", ".", "?", ","] + words) + "\n"
+        )
+        # NQ-jsonl schema mirrors tests/helpers.py::nq_line (kept inline so
+        # the driver can run bench.py without the tests tree) — update both
+        # if the preprocessor's expected schema ever changes
+        rng = np.random.default_rng(0)
+        with open(tmp / "corpus.jsonl", "w") as fh:
+            for i in range(args.infer_docs):
+                doc = "<P> " + " ".join(
+                    rng.choice(words, size=args.infer_doc_len)
+                ) + " . </P>"
+                line = {
+                    "example_id": str(i),
+                    "document_text": doc,
+                    "question_text": " ".join(rng.choice(words, size=8)) + " ?",
+                    "annotations": [{
+                        "yes_no_answer": "NONE",
+                        "long_answer": {
+                            "start_token": 0,
+                            "end_token": 12,
+                            "candidate_index": 0,
+                        },
+                        "short_answers": [{"start_token": 2, "end_token": 4}],
+                    }],
+                    "long_answer_candidates": [
+                        {"start_token": 0, "end_token": 12, "top_level": True}
+                    ],
+                }
+                fh.write(json.dumps(line) + "\n")
+
+        tokenizer = Tokenizer("bert", str(tmp / "vocab.txt"), lowercase=True)
+        preprocessor = RawPreprocessor(
+            raw_json=tmp / "corpus.jsonl", out_dir=tmp / "proc"
+        )
+        _, _, (train_indexes, _, val_indexes, _) = preprocessor()
+        indexes = np.concatenate([train_indexes, val_indexes])
+
+        def make_dataset(idx):
+            return ChunkDataset(
+                tmp / "proc", tokenizer, idx,
+                max_seq_len=L, max_question_len=16, doc_stride=args.doc_stride,
+                split_by_sentence=False,
+                cache_size=0,  # no cross-pass token cache: every timed pass
+                               # pays the real tokenize-on-read cost
+            )
+
+        cfg = MODEL_PRESETS[args.model]
+        model = QAModel(cfg, dtype=jnp.bfloat16, attention_impl="auto")
+        params = model.init(
+            jax.random.key(0), np.zeros((1, 8), dtype=np.int32)
+        )["params"]
+        collate = init_collate_fun(tokenizer, max_seq_len=L, return_items=True)
+
+        predictor = Predictor(
+            model, params, mesh=mesh, collate_fun=collate,
+            batch_size=args.global_batch, n_jobs=args.infer_jobs,
+        )
+
+        # compile warmup on a 2-doc slice (same static shapes)
+        predictor(make_dataset(indexes[:2]))
+
+        window_rates = []
+        for _ in range(max(1, args.window)):
+            predictor.scores.clear()
+            predictor.candidates.clear()
+            predictor.items.clear()
+            t0 = time.perf_counter()
+            predictor(make_dataset(indexes), save_dump=True)
+            elapsed = time.perf_counter() - t0
+            chunks = sum(len(d[-1]) for d in predictor.dump)
+            window_rates.append(chunks / elapsed)
+        # every document's chunks flowed through the loop (candidate VALIDITY
+        # is score-dependent and not guaranteed under random-init params)
+        seen_docs = {it.item_id for d in predictor.dump for it in d[-1]}
+        assert len(seen_docs) == len(indexes), (len(seen_docs), len(indexes))
+
+        per_chip = float(np.median(window_rates)) / n_chips
+        print(
+            json.dumps(
+                {
+                    "metric": f"{args.model}_qa_infer_seq{L}_chunks_per_sec_per_chip",
+                    "value": round(per_chip, 2),
+                    "unit": "chunks/sec/chip",
+                    "vs_baseline": round(
+                        per_chip / V100_INFER_CHUNKS_PER_SEC_EST, 3
+                    ),
+                    "chunks": chunks,
+                    "docs": int(len(indexes)),
+                    "chunks_per_sec_windows": [round(r, 1) for r in window_rates],
+                    "batch_size": args.global_batch,
+                    "n_chips": n_chips,
+                    "backend": jax.default_backend(),
+                }
+            )
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def main() -> None:
     parser = argparse.ArgumentParser()
+    parser.add_argument("--mode", choices=("train", "infer"), default="train")
     parser.add_argument("--seq_len", type=int, default=512)
     parser.add_argument("--global_batch", type=int, default=256)
     # micro-batch 64 (split 4) is the measured single-v5e sweet spot with the
@@ -35,11 +170,25 @@ def main() -> None:
     # steps are timed in windows of --window; the reported number is the
     # MEDIAN window (the tunneled shared chip shows rare 10x contention
     # stalls — a single aggregate window would record one as the result)
-    parser.add_argument("--steps", type=int, default=16)
-    parser.add_argument("--window", type=int, default=4)
-    parser.add_argument("--warmup", type=int, default=2)
+    parser.add_argument("--steps", type=int, default=16,
+                        help="train mode only; infer paces by --infer_docs")
+    parser.add_argument("--window", type=int, default=4,
+                        help="train: steps per timing window; infer: number "
+                             "of timed full passes (median reported)")
+    parser.add_argument("--warmup", type=int, default=2,
+                        help="train mode only; infer warms up with one "
+                             "2-doc compile pass")
     parser.add_argument("--model", type=str, default="bert-base-uncased")
+    # --mode infer knobs (192 docs x ~12 chunks = 9 batches/pass: enough to
+    # reach the loader/device pipeline's steady state)
+    parser.add_argument("--infer_docs", type=int, default=192)
+    parser.add_argument("--infer_doc_len", type=int, default=3000)
+    parser.add_argument("--infer_jobs", type=int, default=16)
+    parser.add_argument("--doc_stride", type=int, default=256)
     args = parser.parse_args()
+
+    if args.mode == "infer":
+        return bench_infer(args)
 
     import jax
     import jax.numpy as jnp
