@@ -116,16 +116,18 @@ class Predictor:
             # ONE packed [6, B] f32 output: the per-batch host gather is a
             # single fetch instead of six (device->host round-trips dominate
             # the loop once the forward is fused; ids/labels are exact in
-            # f32 — L and the 5-class space are far below 2^24)
+            # f32 — L and the 5-class space are far below 2^24). Row order
+            # comes from _OUT_KEYS, the same tuple consume() decodes by.
+            fields = {
+                "scores": scores,
+                "start_ids": start_ids,
+                "end_ids": end_ids,
+                "start_regs": preds["start_reg"],
+                "end_regs": preds["end_reg"],
+                "labels": cls_ids,
+            }
             return jnp.stack(
-                [
-                    scores,
-                    start_ids.astype(jnp.float32),
-                    end_ids.astype(jnp.float32),
-                    preds["start_reg"].astype(jnp.float32),
-                    preds["end_reg"].astype(jnp.float32),
-                    cls_ids.astype(jnp.float32),
-                ],
+                [fields[k].astype(jnp.float32) for k in Predictor._OUT_KEYS],
                 axis=0,
             )
 

@@ -1,0 +1,95 @@
+"""Data-parallel trajectory equivalence: mesh ``data:8`` vs a single device.
+
+The framework claims DDP gradient-mean semantics (trainer.py header: GSPMD's
+psum-mean over the data axis == DDP averaging, reference trainer/trainer.py:
+197-204). Round-1 review: that claim was asserted, never tested. These tests
+run the SAME seed and data order on an 8-way data mesh and on one device and
+require the loss trajectory and final parameters to coincide within f32
+reduction-reordering tolerance — with gradient accumulation and with ZeRO-1
+optimizer-state sharding on the mesh side.
+
+Dropout variants use ``threefry2x32`` (partitionable: bits depend only on
+logical indices, so masks are mesh-invariant). The production default ``rbg``
+is hardware-keyed and intentionally NOT mesh-invariant — DDP itself never
+promised cross-topology dropout determinism (each reference GPU draws its own
+torch masks).
+"""
+
+import numpy as np
+
+import jax
+
+from test_trainer import TP, _make_trainer, _param_snapshot
+
+
+def _run(trainer):
+    """Train and return (per-step losses, final params)."""
+    trainer._jit_train_step = trainer._build_train_step()
+    inner = trainer._jit_train_step
+    losses = []
+
+    def recording_step(params, opt_state, inputs, labels, step):
+        out = inner(params, opt_state, inputs, labels, step)
+        losses.append(float(jax.device_get(out[2]["loss"])))
+        return out
+
+    trainer._jit_train_step = recording_step
+    trainer.train()
+    return losses, _param_snapshot(trainer.params)
+
+
+def _assert_same_trajectory(a, b, *, rtol=2e-5, atol=2e-6):
+    losses_a, params_a = a
+    losses_b, params_b = b
+    assert len(losses_a) == len(losses_b) and len(losses_a) >= 4
+    np.testing.assert_allclose(
+        losses_a, losses_b, rtol=rtol, atol=atol,
+        err_msg="per-step loss trajectories diverge across meshes",
+    )
+    flat_a = jax.tree_util.tree_leaves(params_a)
+    flat_b = jax.tree_util.tree_leaves(params_b)
+    for x, y in zip(flat_a, flat_b):
+        np.testing.assert_allclose(
+            x, y, rtol=1e-4, atol=1e-5,
+            err_msg="final params diverge across meshes",
+        )
+
+
+def test_dp8_matches_single_device(tmp_path):
+    dp, _ = _make_trainer(tmp_path, mesh_spec="data:8", dropout=0.0,
+                          n_epochs=2)
+    single, _ = _make_trainer(tmp_path, mesh_spec="data:1",
+                              dropout=0.0, n_epochs=2)
+    _assert_same_trajectory(_run(dp), _run(single))
+
+
+def test_dp8_matches_single_device_with_batch_split(tmp_path):
+    dp, _ = _make_trainer(tmp_path, mesh_spec="data:8", dropout=0.0,
+                          n_epochs=2, batch_split=2)
+    single, _ = _make_trainer(tmp_path, mesh_spec="data:1",
+                              dropout=0.0, n_epochs=2, batch_split=2)
+    _assert_same_trajectory(_run(dp), _run(single))
+
+
+def test_dp8_zero_matches_single_device(tmp_path):
+    """ZeRO-1 sharded optimizer on the mesh vs plain replicated single-device:
+    sharding the moments must not change the math."""
+    dp, _ = _make_trainer(
+        tmp_path, mesh_spec="data:8", dropout=0.0, n_epochs=2,
+        batch_split=2, shard_optimizer=True, zero_min_size=0,
+    )
+    single, _ = _make_trainer(tmp_path, mesh_spec="data:1",
+                              dropout=0.0, n_epochs=2, batch_split=2)
+    _assert_same_trajectory(_run(dp), _run(single))
+
+
+def test_dp8_matches_single_device_with_threefry_dropout(tmp_path):
+    """With the partitionable threefry PRNG, even the dropout masks are a
+    function of logical index only — the full stochastic trajectory must be
+    mesh-invariant."""
+    dp, _ = _make_trainer(tmp_path, mesh_spec="data:8", dropout=0.1,
+                          n_epochs=2, prng_impl="threefry2x32")
+    single, _ = _make_trainer(tmp_path, mesh_spec="data:1",
+                              dropout=0.1, n_epochs=2,
+                              prng_impl="threefry2x32")
+    _assert_same_trajectory(_run(dp), _run(single))

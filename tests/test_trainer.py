@@ -62,7 +62,7 @@ class TP:
 
 def _make_trainer(tmp_path, *, batch_split=1, n_epochs=1, debug=False,
                   train_len=32, test_len=10, dropout=0.1, tp_cls=TP,
-                  mesh_spec="data:8"):
+                  mesh_spec="data:8", **trainer_extra):
     tokenizer = make_tokenizer(tmp_path)
     rng = np.random.default_rng(0)
     train_ds = DummyDataset(
@@ -104,6 +104,7 @@ def _make_trainer(tmp_path, *, batch_split=1, n_epochs=1, debug=False,
         max_grad_norm=1.0,
         debug=debug,
         seed=0,
+        **trainer_extra,
     )
     return trainer, tmp_path
 
