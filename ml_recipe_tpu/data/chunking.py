@@ -79,8 +79,16 @@ def drop_tags_and_encode(
 
 
 def encode_document(tokenizer, text: str):
-    """Whole-document encoding with offset maps."""
+    """Whole-document encoding with offset maps.
+
+    ``o2t`` gets a trailing SENTINEL entry ``o2t[n_words] == n_tokens``:
+    answer spans use exclusive word ends, so a span ending at the document's
+    last word maps through ``o2t[len(words)]``. (The reference indexes o2t
+    unguarded, split_dataset.py:274-275 — it crashes on a corpus line whose
+    annotated long answer is the final candidate; found by the real-schema
+    fixtures, tests/test_nq_fixtures.py.)"""
     token_ids, o2t, t2o, _, _ = drop_tags_and_encode(tokenizer, text)
+    o2t.append(len(token_ids))
     return token_ids, o2t, t2o
 
 
@@ -104,6 +112,9 @@ def encode_document_by_sentences(
         o2t.extend(o2t_)
         t2o.extend(t2o_)
 
+    # same end-of-document sentinel as encode_document: exclusive span ends
+    # at the last word map to one past the last token
+    o2t.append(history)
     return t_sens, o2t, t2o
 
 

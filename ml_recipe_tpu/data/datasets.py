@@ -163,8 +163,16 @@ class _ChunkingDatasetBase:
         class_label, start_position, end_position = RawPreprocessor._get_target(line)
 
         assert start_position <= end_position, "Before mapping."
-        start_position = o2t[start_position]
-        end_position = o2t[end_position]
+        if start_position < 0:
+            # 'unknown': there is no answer span. The reference maps -1
+            # through o2t[-1] (split_dataset.py:274-275), silently training
+            # the span heads toward the document's last token on whichever
+            # chunk contains it; keep the spanless (-1, -1) sentinel instead
+            # (the losses/metrics mask -1).
+            start_position = end_position = -1
+        else:
+            start_position = o2t[start_position]
+            end_position = o2t[end_position]
         assert start_position <= end_position, "After mapping."
 
         target = (class_label, start_position, end_position)

@@ -19,7 +19,7 @@ import numpy as np
 
 import jax
 
-from test_trainer import TP, _make_trainer, _param_snapshot
+from test_trainer import _make_trainer, _param_snapshot
 
 
 def _run(trainer):

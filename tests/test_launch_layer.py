@@ -1,0 +1,177 @@
+"""Execute the shell launch layer itself (round-1 gap: `scripts/worker.sh`
+was never run by any test — the 2-process test drove the Python layer
+directly, leaving the shell contract trust-me).
+
+Spawns TWO real `worker.sh` processes (the platform env contract
+MASTER_IP/MASTER_PORT/WORLD_SIZE/LOCAL_RANK, reference worker.sh:1-6 /
+live.yml:126-132): each runs the qacoord readiness handshake, execs the real
+train CLI with `--dist_*` flags, joins the world via
+`jax.distributed.initialize`, and runs a debug train step on the dummy
+dataset over the cross-process data mesh.
+"""
+
+import os
+import shutil
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = Path(__file__).resolve().parent.parent
+WORKER_SH = REPO / "scripts" / "worker.sh"
+
+from helpers import write_vocab  # noqa: E402
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.skipif(shutil.which("bash") is None, reason="bash unavailable")
+def test_worker_sh_two_process_debug_train(tmp_path):
+    vocab = write_vocab(tmp_path)
+
+    last = None
+    for _attempt in range(3):  # retry port-steal races
+        port = _free_port()
+        procs = []
+        for rank in range(2):
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)  # 1 CPU device per process
+            env.update(
+                PYTHONPATH=str(REPO),
+                MASTER_IP="127.0.0.1",
+                MASTER_PORT=str(port),
+                WORLD_SIZE="2",
+                LOCAL_RANK=str(rank),
+                JAX_PLATFORMS="cpu",
+            )
+            procs.append(
+                subprocess.Popen(
+                    [
+                        "bash", str(WORKER_SH),
+                        "--model", "bert-tiny",
+                        "--vocab_file", str(vocab),
+                        "--dummy_dataset",
+                        "--data_path", str(tmp_path),
+                        "--processed_data_path", str(tmp_path / "proc"),
+                        "--dump_dir", str(tmp_path / "results"),
+                        "--experiment_name", "launch",
+                        "--max_seq_len", "64",
+                        "--max_question_len", "16",
+                        "--n_epochs", "2",
+                        "--train_batch_size", "4",
+                        "--test_batch_size", "4",
+                        "--batch_split", "1",
+                        "--n_jobs", "0",
+                        "--lr", "1e-3",
+                        "--warmup_coef", "0.1",
+                        "--seed", "0",
+                        "--debug",
+                    ],
+                    env=env,
+                    cwd=str(REPO),
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                )
+            )
+        outs = []
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=420)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise
+            outs.append(out)
+        last = list(zip(procs, outs))
+        if any("already in use" in o or "Failed to bind" in o for o in outs):
+            continue
+        break
+
+    for rank, (p, out) in enumerate(last):
+        assert p.returncode == 0, f"worker.sh rank {rank} failed:\n{out[-4000:]}"
+
+    # non-zero ranks log at WARN (reference train.py:37-39 parity), so the
+    # INFO-level evidence lives in rank 0's stream only
+    rank0_out = last[0][1]
+    assert "Execution of _train took" in rank0_out, rank0_out[-4000:]
+    # the shell layer fed the right topology: 2-process world, one device
+    # each, global mesh over both
+    assert "Built device mesh {'data': 2}" in rank0_out, rank0_out[-4000:]
+    # debug mode ran to the end of the epoch loop
+    assert "because of debug mode" in rank0_out
+    assert "Test metrics after epoch 2" in rank0_out
+
+    # SPMD eval: both ranks drive the same jitted eval over the global mesh
+    # — their running-loss postfixes must agree value for value
+    import re
+
+    def eval_losses(out):
+        return re.findall(r"Test \(epoch #2[^\n]*?loss: ([0-9.e+-]+)", out)
+
+    l0, l1 = eval_losses(last[0][1]), eval_losses(last[1][1])
+    assert l0 and l1
+    assert set(l0) == set(l1), (l0[-3:], l1[-3:])
+
+    # effective-config round-trip serialization happened (rank 0 only)
+    exp_dir = tmp_path / "results" / "launch"
+    assert any(exp_dir.glob("*.cfg")), list(exp_dir.glob("*"))
+
+
+@pytest.mark.skipif(shutil.which("bash") is None, reason="bash unavailable")
+def test_worker_sh_master_ip_self_resolution(tmp_path):
+    """MASTER_IP=0 -> the script substitutes the local hostname (reference
+    worker.sh:1-5 convention) — verified via dry inspection: run with
+    WORLD_SIZE=1 so no rendezvous is needed and training is single-process."""
+    vocab = write_vocab(tmp_path)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update(
+        PYTHONPATH=str(REPO),
+        MASTER_IP="0",
+        MASTER_PORT=str(_free_port()),
+        WORLD_SIZE="1",
+        LOCAL_RANK="0",
+        JAX_PLATFORMS="cpu",
+    )
+    out = subprocess.run(
+        [
+            "bash", str(WORKER_SH),
+            "--model", "bert-tiny",
+            "--vocab_file", str(vocab),
+            "--dummy_dataset",
+            "--data_path", str(tmp_path),
+            "--processed_data_path", str(tmp_path / "proc"),
+            "--dump_dir", str(tmp_path / "results"),
+            "--experiment_name", "solo",
+            "--max_seq_len", "64",
+            "--max_question_len", "16",
+            "--n_epochs", "1",
+            "--train_batch_size", "4",
+            "--test_batch_size", "4",
+            "--batch_split", "1",
+            "--n_jobs", "0",
+            "--lr", "1e-3",
+            "--warmup_coef", "0.1",
+            "--seed", "0",
+            "--debug",
+        ],
+        env=env,
+        cwd=str(REPO),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        timeout=420,
+    )
+    assert out.returncode == 0, out.stdout[-4000:]
+    assert "Execution of _train took" in out.stdout
+    # the tcp:// init method must carry a real hostname, not the literal 0
+    assert "tcp://0:" not in out.stdout
