@@ -106,6 +106,7 @@ def run_worker(params, model_params) -> None:
         debug=params.debug,
         seed=params.seed if params.seed is not None else 0,
         shard_optimizer=getattr(params, "shard_optimizer", False),
+        sharded_checkpoint=getattr(params, "sharded_checkpoint", False),
         trace_dir=(
             params.dump_dir / f"board/{params.experiment_name}/trace"
             if getattr(params, "trace", False) else None

@@ -366,6 +366,12 @@ def get_trainer_parser() -> ConfigArgumentParser:
                              "data axis (memory 1/N; XLA all-gathers the "
                              "sharded updates). The reference replicates "
                              "optimizer state per process.")
+    parser.add_argument("--sharded_checkpoint", action="store_true",
+                        help="Checkpoint saves write a per-process sharded "
+                             "directory (each host saves only the array "
+                             "shards it owns) instead of gathering the full "
+                             "state for one single-file write. Restore "
+                             "auto-detects either layout.")
     parser.add_argument("--sync_bn", action="store_true",
                         help="Cross-replica normalization statistics sync (reference "
                              "SyncBN flag; BERT has LayerNorm so this is a no-op "
