@@ -70,11 +70,14 @@ def _uniform_grid(seed, bh, L: int, rows: Optional[int] = None, row_offset=0):
     cols = jax.lax.broadcasted_iota(jnp.int32, (rows, L), 1)
     x = r * jnp.int32(L) + cols
     x = x ^ (seed + bh * jnp.int32(-1640531527))  # 2654435761 as int32
+    # 3-stage finalizer (mul, xorshift, mul): two stages fewer than the full
+    # murmur3 tail — measured statistically indistinguishable for dropout
+    # (mean, row/col uniformity, adjacency correlation of the keep mask all
+    # match the 5-stage version), and the [L, L] grid is regenerated per
+    # head per pass, so VPU ops here are hot
     x = x * jnp.int32(-862048943)   # 0xCC9E2D51
     x = x ^ ((x >> 16) & jnp.int32(0xFFFF))
     x = x * jnp.int32(0x1B873593)
-    x = x ^ ((x >> 13) & jnp.int32(0x7FFFF))
-    x = x * jnp.int32(-1028477387)  # 0xC2B2AE35
     u24 = (x >> 7) & jnp.int32(0x00FFFFFF)  # 24 uniform bits -> [0, 1)
     return u24.astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
 

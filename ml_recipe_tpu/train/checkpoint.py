@@ -19,7 +19,6 @@ TPU deltas:
 
 from __future__ import annotations
 
-import glob as _glob
 import logging
 import os
 from typing import Any, Optional
@@ -140,11 +139,27 @@ def save_state_dict_sharded(
     import jax
 
     path = os.fspath(path)
+    if os.path.isdir(path) and os.listdir(path) and not os.path.exists(
+        os.path.join(path, _MANIFEST)
+    ):
+        # same safety rule as the single-file save: a populated directory
+        # that is not one of our checkpoints is not ours to write into
+        raise IsADirectoryError(
+            f"checkpoint path {path} is a non-empty directory that is not a "
+            f"sharded checkpoint; refusing to write into it"
+        )
     if os.path.isfile(path):
         # a single-file checkpoint previously lived at this name (the flag
-        # was toggled on mid-experiment); replace it with the directory
+        # was toggled on mid-experiment); replace it with the directory.
+        # Barrier afterwards: on a shared filesystem another process must
+        # not hit makedirs while the file still exists (exist_ok only
+        # forgives existing DIRECTORIES).
         if jax.process_index() == 0:
             os.remove(path)
+        if jax.process_count() > 1:
+            from ..parallel import barrier
+
+            barrier("sharded_ckpt_clear")
     os.makedirs(path, exist_ok=True)
 
     groups = {"model": params}
@@ -364,6 +379,16 @@ def load_state_dict(
     if os.path.isdir(path):
         # sharded-directory format (save_state_dict_sharded); --last works
         # transparently for either layout
+        if not os.path.exists(os.path.join(path, _MANIFEST)):
+            # a save interrupted between makedirs and the manifest write
+            # leaves a manifest-less directory — same warn-and-continue
+            # contract as a missing checkpoint file (reference
+            # trainer.py:381-385), with a diagnostic
+            logger.warning(
+                f"Checkpoint directory {path} has no {_MANIFEST} (interrupted "
+                f"first sharded save?); checkpoint was not loaded."
+            )
+            return params, opt_state, loss_scale, None
         return load_state_dict_sharded(
             path,
             params=params,
