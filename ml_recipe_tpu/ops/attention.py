@@ -51,6 +51,17 @@ def _xla_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _dropout_seed(dropout_rng):
+    """int32 seed array (1,) for the in-kernel/in-flight dropout hash — ONE
+    derivation shared by the pallas and ring paths so their documented
+    mask-identity cannot drift."""
+    assert dropout_rng is not None, "dropout_rate > 0 needs dropout_rng"
+    return jax.random.randint(
+        dropout_rng, (1,), minval=jnp.iinfo(jnp.int32).min,
+        maxval=jnp.iinfo(jnp.int32).max, dtype=jnp.int32,
+    )
+
+
 def dot_product_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -82,15 +93,11 @@ def dot_product_attention(
             if DATA_AXIS in mesh.axis_names and mesh.shape[DATA_AXIS] > 1
             else None
         )
-        if dropout_rate > 0.0:
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "ring attention has no attention-dropout path; dropout skipped."
-            )
+        seed = _dropout_seed(dropout_rng) if dropout_rate > 0.0 else None
         return ring_attention(
             q, k, v, mask, mesh=mesh, axis_name=SEQ_AXIS,
             batch_axis=batch_axis, dtype=dtype,
+            rate=dropout_rate, seed=seed,
         )
 
     if impl in ("auto", "pallas"):
@@ -127,13 +134,7 @@ def dot_product_attention(
                 f"attention instead."
             )
         else:
-            seed = None
-            if dropout_rate > 0.0:
-                assert dropout_rng is not None, "dropout_rate > 0 needs dropout_rng"
-                seed = jax.random.randint(
-                    dropout_rng, (1,), minval=jnp.iinfo(jnp.int32).min,
-                    maxval=jnp.iinfo(jnp.int32).max, dtype=jnp.int32,
-                )
+            seed = _dropout_seed(dropout_rng) if dropout_rate > 0.0 else None
             return flash_attention(
                 q, k, v, mask, seed=seed, dtype=dtype, rate=dropout_rate
             )

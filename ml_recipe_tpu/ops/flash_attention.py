@@ -70,11 +70,19 @@ def _uniform_grid(seed, bh, L: int, rows: Optional[int] = None, row_offset=0):
     cols = jax.lax.broadcasted_iota(jnp.int32, (rows, L), 1)
     x = r * jnp.int32(L) + cols
     x = x ^ (seed + bh * jnp.int32(-1640531527))  # 2654435761 as int32
-    # 3-stage finalizer (mul, xorshift, mul): two stages fewer than the full
-    # murmur3 tail — measured statistically indistinguishable for dropout
-    # (mean, row/col uniformity, adjacency correlation of the keep mask all
-    # match the 5-stage version), and the [L, L] grid is regenerated per
-    # head per pass, so VPU ops here are hot
+    return hash_uniform(x)
+
+
+def hash_uniform(x):
+    """int32 array -> uniform floats in [0, 1).
+
+    3-stage finalizer (mul, xorshift, mul): two stages fewer than the full
+    murmur3 tail — measured statistically indistinguishable for dropout
+    (mean, row/col uniformity, adjacency correlation of the keep mask all
+    match the 5-stage version), and the grids are regenerated per head per
+    pass, so VPU ops here are hot. Shared with ring attention's in-flight
+    dropout (ops/ring_attention.py), which keys the same finalizer by
+    GLOBAL indices so its masks are shard-count invariant."""
     x = x * jnp.int32(-862048943)   # 0xCC9E2D51
     x = x ^ ((x >> 16) & jnp.int32(0xFFFF))
     x = x * jnp.int32(0x1B873593)

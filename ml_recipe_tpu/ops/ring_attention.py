@@ -26,23 +26,63 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG_INF = -1e30
 
 
-def _ring_attention_local(q, k, v, mask, *, axis_name: str, scale: float):
+def _ring_attention_local(q, k, v, mask, *, axis_name: str, scale: float,
+                          rate: float = 0.0, seed=None,
+                          batch_axis: Optional[str] = None):
     """Per-shard body (runs under shard_map).
 
     q/k/v: [B, L_loc, H, D] local slices; mask: [B, L_loc] key validity.
     Returns [B, L_loc, H, D] — the exact softmax(QK^T)V rows for local Q
     against the FULL global K/V.
+
+    Attention-probs dropout (``rate > 0``): keep-bits come from the shared
+    :func:`ops.flash_attention.hash_uniform` finalizer keyed by the GLOBAL
+    (batch, head, row, col) index — each rotating K/V block's global column
+    offset is derived from the ring step, so the mask is independent of how
+    many shards the sequence is split over, and identical whether computed
+    here or in a single-device kernel. Matching torch semantics, the
+    softmax DENOMINATOR is undropped; only the value-weighting probs are
+    masked and inverse-scaled.
     """
+    from .flash_attention import hash_uniform
+
     n_shards = jax.lax.psum(1, axis_name)
     perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    my_idx = jax.lax.axis_index(axis_name)
 
     B, L_loc, H, D = q.shape
+    L_total = n_shards * L_loc
+
+    if rate > 0.0:
+        seed_val = seed[0].astype(jnp.int32)
+        if batch_axis is not None:
+            # decorrelate data-parallel groups: their local batch indices
+            # overlap, so fold the dp coordinate into the seed
+            seed_val = seed_val + jax.lax.axis_index(batch_axis) * jnp.int32(
+                -1640531527
+            )
+        bh = (
+            jnp.arange(B, dtype=jnp.int32)[:, None] * jnp.int32(H)
+            + jnp.arange(H, dtype=jnp.int32)[None, :]
+        )  # [B, H]
+        row_ids = (my_idx * L_loc + jnp.arange(L_loc, dtype=jnp.int32))
+
+    def keep_block(step):
+        """[B, H, L_loc, L_loc] keep-bits for ring step ``step``: the block
+        held now originated at shard (my_idx - step) mod n_shards."""
+        col_off = ((my_idx - step) % n_shards) * L_loc
+        col_ids = col_off + jnp.arange(L_loc, dtype=jnp.int32)
+        x = row_ids[:, None] * jnp.int32(L_total) + col_ids[None, :]
+        x = x[None, None, :, :] ^ (
+            seed_val + bh[:, :, None, None] * jnp.int32(-1640531527)
+        )
+        return hash_uniform(x) >= rate
 
     def block_scores(k_blk, mask_blk):
         s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
         return jnp.where(mask_blk[:, None, None, :] > 0, s, _NEG_INF)
 
-    def accumulate(carry, k_cur, v_cur, mask_cur):
+    def accumulate(carry, k_cur, v_cur, mask_cur, step):
         o_acc, m_acc, l_acc = carry
 
         s = block_scores(k_cur, mask_cur)                      # [B,H,Lq,Lk]
@@ -51,14 +91,20 @@ def _ring_attention_local(q, k, v, mask, *, axis_name: str, scale: float):
         p = jnp.exp(s - m_new[..., None])                      # [B,H,Lq,Lk]
         corr = jnp.exp(m_acc - m_new)                          # [B,H,Lq]
 
+        # the denominator accumulates UNdropped p (torch applies dropout
+        # after softmax); only the value weighting is masked
         l_new = l_acc * corr + jnp.sum(p, axis=-1)
-        o_blk = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_cur.dtype), v_cur)
+        if rate > 0.0:
+            p_v = jnp.where(keep_block(step), p * (1.0 / (1.0 - rate)), 0.0)
+        else:
+            p_v = p
+        o_blk = jnp.einsum("bhqk,bkhd->bqhd", p_v.astype(v_cur.dtype), v_cur)
         o_new = o_acc * corr.transpose(0, 2, 1)[..., None] + o_blk.astype(jnp.float32)
         return o_new, m_new, l_new
 
     def body(i, carry):
         acc, k_cur, v_cur, mask_cur = carry
-        acc = accumulate(acc, k_cur, v_cur, mask_cur)
+        acc = accumulate(acc, k_cur, v_cur, mask_cur, i)
         # rotate K/V/mask one step around the ring (ICI neighbour copy,
         # overlapped with the next block's compute by the scheduler)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
@@ -75,7 +121,7 @@ def _ring_attention_local(q, k, v, mask, *, axis_name: str, scale: float):
     acc, k_last, v_last, mask_last = jax.lax.fori_loop(
         0, n_shards - 1, body, ((o0, m0, l0), k, v, mask)
     )
-    o, m, l = accumulate(acc, k_last, v_last, mask_last)
+    o, m, l = accumulate(acc, k_last, v_last, mask_last, n_shards - 1)
 
     denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]  # [B,Lq,H,1]
     return (o / denom).astype(q.dtype)
@@ -91,6 +137,8 @@ def ring_attention(
     axis_name: str = "seq",
     batch_axis: Optional[str] = None,
     dtype=jnp.float32,
+    rate: float = 0.0,
+    seed=None,
 ):
     """Exact global attention with Q/K/V sharded over ``axis_name``.
 
@@ -99,22 +147,29 @@ def ring_attention(
     sequence-sharded the same way. ``batch_axis`` names the mesh axis the
     batch dim is data-parallel over (composes dp x sp inside one jitted
     step); None replicates over any remaining axes.
+
+    ``rate``/``seed``: attention-probs dropout applied in-flight during the
+    ring sweep; the keep-mask is keyed by global indices, so results are
+    invariant to the number of sequence shards.
     """
     if mask is None:
         mask = jnp.ones(q.shape[:2], dtype=jnp.int32)
+    if seed is None:
+        seed = jnp.zeros((1,), dtype=jnp.int32)
 
     scale = 1.0 / (q.shape[-1] ** 0.5)
     fn = functools.partial(
-        _ring_attention_local, axis_name=axis_name, scale=scale
+        _ring_attention_local, axis_name=axis_name, scale=scale,
+        rate=rate, batch_axis=batch_axis,
     )
 
     seq_spec = P(batch_axis, axis_name, None, None)
     mask_spec = P(batch_axis, axis_name)
 
     return jax.shard_map(
-        fn,
+        lambda q_, k_, v_, m_, s_: fn(q_, k_, v_, m_, seed=s_),
         mesh=mesh,
-        in_specs=(seq_spec, seq_spec, seq_spec, mask_spec),
+        in_specs=(seq_spec, seq_spec, seq_spec, mask_spec, P(None)),
         out_specs=seq_spec,
         check_vma=False,
-    )(q.astype(dtype), k.astype(dtype), v.astype(dtype), mask)
+    )(q.astype(dtype), k.astype(dtype), v.astype(dtype), mask, seed)
