@@ -112,12 +112,44 @@ def test_resolve_model_config():
     assert cfg.num_labels == 5
 
 
-def test_hf_numerical_parity():
-    """Convert a tiny randomly-initialized HF BertModel and match outputs."""
-    torch = pytest.importorskip("torch")
-    from transformers import BertConfig, BertModel
+def _assert_hf_parity(hf_model, cfg, ids, mask, token_type_ids=None):
+    """Shared warm-start parity harness: convert an HF model's state dict
+    and require our encoder to reproduce its outputs."""
+    import torch
 
     from ml_recipe_tpu.models.hf_convert import hf_to_encoder_params
+
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    encoder_params = hf_to_encoder_params(sd, num_layers=cfg.num_layers)
+    model = TransformerEncoder(cfg)
+
+    hf_kwargs = dict(
+        input_ids=torch.tensor(ids, dtype=torch.long),
+        attention_mask=torch.tensor(mask, dtype=torch.long),
+    )
+    if token_type_ids is not None:
+        hf_kwargs["token_type_ids"] = torch.tensor(
+            token_type_ids, dtype=torch.long
+        )
+    with torch.no_grad():
+        hf_out = hf_model(**hf_kwargs)
+
+    seq, pooled = model.apply(
+        {"params": encoder_params}, ids, mask, token_type_ids
+    )
+
+    np.testing.assert_allclose(
+        np.asarray(seq), hf_out.last_hidden_state.numpy(), atol=5e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(pooled), hf_out.pooler_output.numpy(), atol=5e-3
+    )
+
+
+def test_hf_numerical_parity():
+    """Convert a tiny randomly-initialized HF BertModel and match outputs."""
+    pytest.importorskip("torch")
+    from transformers import BertConfig, BertModel
 
     hf_cfg = BertConfig(
         vocab_size=100,
@@ -131,31 +163,47 @@ def test_hf_numerical_parity():
         attention_probs_dropout_prob=0.0,
         layer_norm_eps=1e-12,
     )
-    hf_model = BertModel(hf_cfg).eval()
-
-    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
-    encoder_params = hf_to_encoder_params(sd, num_layers=2)
-
     cfg = EncoderConfig(
         vocab_size=100, hidden_size=32, num_layers=2, num_heads=4,
         intermediate_size=64, max_position_embeddings=64,
         hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
     )
-    model = TransformerEncoder(cfg)
-
     ids, mask, tt = _batch(B=2, L=12)
-    with torch.no_grad():
-        hf_out = hf_model(
-            input_ids=torch.tensor(ids, dtype=torch.long),
-            attention_mask=torch.tensor(mask, dtype=torch.long),
-            token_type_ids=torch.tensor(tt, dtype=torch.long),
-        )
+    _assert_hf_parity(BertModel(hf_cfg).eval(), cfg, ids, mask, tt)
 
-    seq, pooled = model.apply({"params": encoder_params}, ids, mask, tt)
 
-    np.testing.assert_allclose(
-        np.asarray(seq), hf_out.last_hidden_state.numpy(), atol=5e-4
+def test_hf_numerical_parity_roberta():
+    """RoBERTa family warm-start parity, exercising the family's deltas
+    (position_offset=2 with padding_idx-based position ids, type_vocab_size
+    1, layer_norm_eps 1e-5). No padding in the batch: HF derives position
+    ids from the non-pad cumsum, which equals arange+2 exactly when every
+    token is real (pad rows are masked out of attention and -inf'd in the
+    QA heads either way)."""
+    pytest.importorskip("torch")
+    from transformers import RobertaConfig, RobertaModel
+
+    hf_cfg = RobertaConfig(
+        vocab_size=100,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        intermediate_size=64,
+        max_position_embeddings=66,  # HF adds padding_idx+1 slots
+        type_vocab_size=1,
+        hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+        layer_norm_eps=1e-5,
+        pad_token_id=1,
     )
-    np.testing.assert_allclose(
-        np.asarray(pooled), hf_out.pooler_output.numpy(), atol=5e-3
+    cfg = EncoderConfig(
+        model_type="roberta", vocab_size=100, hidden_size=32, num_layers=2,
+        num_heads=4, intermediate_size=64, max_position_embeddings=66,
+        type_vocab_size=1, pad_token_id=1, position_offset=2,
+        layer_norm_eps=1e-5,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
     )
+    rng = np.random.default_rng(0)
+    # ids in [2, vocab): no pad token, so HF position ids == arange + 2
+    ids = rng.integers(2, 100, (2, 12)).astype(np.int32)
+    mask = np.ones((2, 12), np.int32)
+    _assert_hf_parity(RobertaModel(hf_cfg).eval(), cfg, ids, mask)
