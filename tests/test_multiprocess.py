@@ -167,21 +167,51 @@ model = QAModel(cfg)
 params = model.init(jax.random.key(0),
                     np.asarray(tr[0].input_ids, np.int32)[None, :])["params"]
 
+sharded = os.environ.get("SHARDED_CKPT") == "1"
+extra = dict(shard_optimizer=True, zero_min_size=0,
+             sharded_checkpoint=True) if sharded else {}
 t = Trainer(model=model, params=params, loss=build_loss(TP()),
             collate_fun=make_collate_fun(tok, max_seq_len=48),
             trainer_params=TP(), train_dataset=tr, test_dataset=te,
             mesh=build_mesh(), n_epochs=1, train_batch_size=16,
             test_batch_size=8, batch_split=2, n_jobs=0,
-            warmup_coef=0.0, max_grad_norm=1.0, seed=0)
+            warmup_coef=0.0, max_grad_norm=1.0, seed=0, **extra)
 metrics = []
 t.train(after_epoch_funcs=[lambda e: metrics.append(t.test(e)["loss"])])
 
-# replica consistency: params are replicated over the global mesh — every
-# process must hold bit-identical values after distributed training
-leaves = jax.tree_util.tree_leaves(t.params)
+# replica consistency: every process must observe bit-identical values
+# after distributed training (gather first: under ZeRO the update layout
+# can leave leaves process-sharded)
+from ml_recipe_tpu.parallel.sharding import gather_to_host
+trained_params = gather_to_host(t.params)
+leaves = jax.tree_util.tree_leaves(trained_params)
 checksum = float(sum(np.asarray(l, dtype=np.float64).sum() for l in leaves))
 ckpt = os.path.join(os.environ["WORK_DIR"], "mp_last.ch")
-t.save_state_dict(ckpt)  # primary-gated internally
+t.save_state_dict(ckpt)  # primary-gated (single-file) / per-process (sharded)
+barrier("ckpt_written")
+
+if sharded:
+    # restore on BOTH processes from the per-process shard files. t2 starts
+    # from DIFFERENT weights (fresh init, key 1) so the assertions below
+    # genuinely prove the model group was restored, not merely retained.
+    fresh = model.init(jax.random.key(1),
+                       np.asarray(tr[0].input_ids, np.int32)[None, :])["params"]
+    t2 = Trainer(model=model, params=fresh, loss=build_loss(TP()),
+                 collate_fun=make_collate_fun(tok, max_seq_len=48),
+                 trainer_params=TP(), train_dataset=tr, test_dataset=te,
+                 mesh=build_mesh(), n_epochs=1, train_batch_size=16,
+                 test_batch_size=8, batch_split=2, n_jobs=0,
+                 warmup_coef=0.0, max_grad_norm=1.0, seed=0, **extra)
+    t2.load_state_dict(ckpt)
+    assert t2.global_step == t.global_step
+    # ZeRO leaves span both processes; gather before comparing
+    for a, b in zip(jax.tree_util.tree_leaves(trained_params),
+                    jax.tree_util.tree_leaves(gather_to_host(t2.params))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(gather_to_host(t.opt_state)),
+                    jax.tree_util.tree_leaves(gather_to_host(t2.opt_state))):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
 print(f"TRAIN_OK rank={jax.process_index()} step={t.global_step} "
       f"loss={metrics[0]:.6f} checksum={checksum:.6f}", flush=True)
 """
@@ -201,3 +231,39 @@ def test_two_process_training_replicas_agree(tmp_path):
     # both replicas trained the same trajectory: same step, loss, checksum
     assert lines[0].split("rank=0 ")[1] == lines[1].split("rank=1 ")[1], lines
     assert (tmp_path / "mp_last.ch").exists()  # primary-only checkpoint write
+
+
+def test_two_process_sharded_checkpoint(tmp_path):
+    """--sharded_checkpoint across a REAL 2-process world: each process
+    writes its own shard file (cross-process replica_id ownership), and both
+    processes restore the exact state from the union of the files."""
+    script = tmp_path / "train_worker.py"
+    script.write_text(TRAIN_WORKER)
+
+    for rank, (p, out) in enumerate(
+        _run_world(script, tmp_path, extra_env={"SHARDED_CKPT": "1"})
+    ):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert any(l.startswith("TRAIN_OK") for l in out.splitlines()), out
+
+    ckpt = tmp_path / "mp_last.ch"
+    assert ckpt.is_dir()
+    assert (ckpt / "manifest.msgpack").exists()
+    shard_files = sorted(f.name for f in ckpt.glob("shard-*.msgpack"))
+    assert shard_files == ["shard-00000.msgpack", "shard-00001.msgpack"]
+
+    from flax import serialization
+
+    manifest = serialization.msgpack_restore(
+        (ckpt / "manifest.msgpack").read_bytes()
+    )
+    assert manifest["process_count"] == 2
+    # replicated leaves have ONE canonical owner: the union of both files
+    # must cover every element exactly once (the in-worker load_state_dict
+    # already proved assembly; here we check the ownership split is real —
+    # both files carry some data)
+    for f in shard_files:
+        blob = serialization.msgpack_restore((ckpt / f).read_bytes())
+        n = sum(len(pieces) for g in blob["shards"].values()
+                for pieces in g.values())
+        assert n > 0, f"{f} owns no shards"
