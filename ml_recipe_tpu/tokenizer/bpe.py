@@ -7,6 +7,7 @@ Replaces the Rust ``tokenizers.ByteLevelBPETokenizer`` the reference wraps in
 
 from __future__ import annotations
 
+import heapq
 import json
 import re
 from functools import lru_cache
@@ -82,7 +83,9 @@ class ByteLevelBPETokenizer:
 
     def _bpe(self, token: str) -> List[str]:
         use_dropout = self.dropout is not None and self.dropout > 0
-        if not use_dropout and token in self._cache:
+        if use_dropout:
+            return self._bpe_dropout(token)
+        if token in self._cache:
             return self._cache[token]
 
         word = list(token)
@@ -91,9 +94,6 @@ class ByteLevelBPETokenizer:
             ranked = [
                 (self.merge_ranks[p], p) for p in pairs if p in self.merge_ranks
             ]
-            if use_dropout:
-                # BPE-dropout: each candidate merge is skipped with prob p.
-                ranked = [rp for rp in ranked if self.rng.random() >= self.dropout]
             if not ranked:
                 break
             _, best = min(ranked)
@@ -108,9 +108,66 @@ class ByteLevelBPETokenizer:
                     i += 1
             word = merged
 
-        if not use_dropout:
-            self._cache[token] = word
+        self._cache[token] = word
         return word
+
+    def _bpe_dropout(self, token: str) -> List[str]:
+        """BPE-dropout (Provilkov et al.) with the Rust library's QUEUE
+        semantics (word.rs ``merge_all``): candidates pop in (rank,
+        position) order; each pop rolls dropout — a skipped candidate goes
+        to a side buffer and is RE-QUEUED as soon as any merge is accepted,
+        so merging only stops when a run of consecutive drops exhausts the
+        queue. (A naive re-roll-every-sweep scheme over-fragments: measured
+        ~165 tokens vs Rust's ~152 at p=0.1 on the same text; permanent
+        single-roll drops over-fragment even more, ~195.)"""
+        syms = list(token)
+        n = len(syms)
+        nxt = list(range(1, n)) + [-1]
+        prev = [-1] + list(range(n - 1))
+        alive = [True] * n
+        heap: List[tuple] = []
+        skipped: List[tuple] = []
+
+        def push(i: int) -> None:
+            j = nxt[i]
+            if j == -1:
+                return
+            r = self.merge_ranks.get((syms[i], syms[j]))
+            if r is not None:
+                heapq.heappush(heap, (r, i, syms[i], syms[j]))
+
+        for i in range(n - 1):
+            push(i)
+
+        while heap:
+            top = heapq.heappop(heap)
+            if self.rng.random() < self.dropout:
+                skipped.append(top)  # dies only if the queue empties first
+                continue
+            for t in skipped:
+                heapq.heappush(heap, t)
+            skipped.clear()
+
+            _, i, a, b = top
+            if not alive[i]:
+                continue
+            j = nxt[i]
+            if j == -1 or syms[i] != a or syms[j] != b:
+                # stale: a neighbour merge changed the pair — requeue the
+                # position's CURRENT pair (rust re-pushes the corrected
+                # candidate) and move on
+                push(i)
+                continue
+            syms[i] = a + b
+            alive[j] = False
+            nxt[i] = nxt[j]
+            if nxt[j] != -1:
+                prev[nxt[j]] = i
+            if prev[i] != -1:
+                push(prev[i])
+            push(i)
+
+        return [s for k, s in enumerate(syms) if alive[k]]
 
     def tokenize(self, text: str) -> List[str]:
         out: List[str] = []

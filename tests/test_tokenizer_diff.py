@@ -261,3 +261,39 @@ def test_facade_special_token_ids_match_rust(rust_wp, ours_wp, rust_bpe, ours_bp
         assert ours_wp.tokenizer.token_to_id(tok) == rust_wp.token_to_id(tok)
     for tok in ("<pad>", "<s>", "</s>", "<unk>"):
         assert ours_bpe.tokenizer.token_to_id(tok) == rust_bpe.token_to_id(tok)
+
+
+def test_bpe_dropout_distribution_matches_rust(bpe_files):
+    """--bpe_dropout regularization strength parity: our queue-semantics
+    BPE-dropout must fragment like the Rust implementation (mean token
+    count within a few percent across rates). Exact per-sample comparison
+    is impossible (different RNGs); the distribution is the contract."""
+    import numpy as np
+
+    from ml_recipe_tpu.tokenizer.bpe import ByteLevelBPETokenizer as PyBPE
+
+    text = (
+        "the quick brown fox jumps over the lazy dog and keeps running "
+        "through the long wikipedia document about question answering "
+    ) * 4
+    for p in (0.1, 0.3):
+        rust = tokenizers.ByteLevelBPETokenizer(
+            bpe_files[0], bpe_files[1], dropout=p
+        )
+        ours = PyBPE(
+            bpe_files[0], bpe_files[1], dropout=p,
+            rng=np.random.default_rng(0),
+        )
+        rust_mean = np.mean([len(rust.encode(text).ids) for _ in range(40)])
+        our_mean = np.mean([len(ours.encode(text)) for _ in range(40)])
+        assert abs(our_mean - rust_mean) / rust_mean < 0.08, (
+            f"p={p}: ours {our_mean:.1f} vs rust {rust_mean:.1f}"
+        )
+
+    # p -> 0 degenerates to the deterministic encode
+    base = tokenizers.ByteLevelBPETokenizer(bpe_files[0], bpe_files[1])
+    ours0 = PyBPE(
+        bpe_files[0], bpe_files[1], dropout=1e-9,
+        rng=np.random.default_rng(0),
+    )
+    assert ours0.encode(text) == base.encode(text).ids
