@@ -195,3 +195,47 @@ def test_cli_sigterm_saves_interrupt_checkpoint(e2e, monkeypatch):
     assert (tmp / "results" / "sigterm" / "interrupt.ch").exists()
     # handler restored after the run
     assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_inference_notebook_executes(e2e, monkeypatch):
+    """Execute the shipped inference notebook's code cells against the
+    trained experiment (the reference notebook was run-by-hand only; here it
+    is part of the suite so API drift cannot rot it silently)."""
+    import json
+    from pathlib import Path
+
+    tmp, cfg, vcfg = e2e
+    exp = tmp / "results" / "e2e"
+    assert (exp / "best.ch").exists(), "train test runs first (module order)"
+
+    nb_path = Path(__file__).resolve().parent.parent / "notebooks" / "inference.ipynb"
+    nb = json.loads(nb_path.read_text())
+    cells = ["".join(c["source"]) for c in nb["cells"] if c["cell_type"] == "code"]
+    assert len(cells) >= 4
+
+    # re-point the notebook's experiment paths at the fixture's run; every
+    # substitution is asserted below so notebook drift fails loudly here
+    # instead of as a confusing downstream error
+    patched = []
+    for src in cells:
+        src = src.replace('"../results/test"', f'"{exp}"')
+        src = src.replace('"../config/validate.cfg"', f'"{vcfg}"')
+        src = src.replace(
+            "params.limit = 20", "params.limit = 3\nparams.n_jobs = 2"
+        )
+        # the notebook's sys.path bootstrap resolves against pytest's CWD —
+        # drop it (the package is already importable) rather than leak a
+        # relative path into the session-wide sys.path
+        src = src.replace('sys.path.insert(0, "..")', "pass")
+        patched.append(src)
+    joined = "\n".join(patched)
+    for needle in (str(exp), str(vcfg), "params.limit = 3", "params.n_jobs = 2"):
+        assert needle in joined, f"notebook patch missed: {needle}"
+    assert 'sys.path.insert(0, "..")' not in joined
+
+    ns: dict = {}
+    for src in patched:
+        exec(compile(src, str(nb_path), "exec"), ns)  # noqa: S102
+
+    predictor = ns["predictor"]
+    assert predictor.scores, "notebook predictor produced no candidates"
