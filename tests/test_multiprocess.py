@@ -56,8 +56,39 @@ mean = jax.jit(
 val = float(mean)
 assert abs(val - 0.5) < 1e-6, val
 
+# ring attention across the PROCESS boundary: the seq axis spans both
+# processes' devices, so every ppermute hop is a cross-process transfer
+# (the multi-host path of the sequence-parallel backend)
+from ml_recipe_tpu.ops.flash_attention import _xla_reference
+from ml_recipe_tpu.ops.ring_attention import ring_attention
+from ml_recipe_tpu.parallel.sharding import gather_to_host
+
+rng2 = np.random.default_rng(7)  # same seed both ranks -> same global q/k/v
+B, L, H, D = 2, 16, 2, 8
+q, k, v = (rng2.normal(size=(B, L, H, D)).astype(np.float32) for _ in range(3))
+ring_mesh = build_mesh("seq:2")
+out = ring_attention(
+    jax.numpy.asarray(q), jax.numpy.asarray(k), jax.numpy.asarray(v),
+    mesh=ring_mesh, rate=0.2,
+    seed=jax.numpy.asarray([5], jax.numpy.int32),
+)
+out_host = gather_to_host(out)
+ref = ring_attention(
+    jax.numpy.asarray(q), jax.numpy.asarray(k), jax.numpy.asarray(v),
+    mesh=ring_mesh, rate=0.0,
+)
+assert np.isfinite(np.asarray(out_host)).all()
+# rate=0 path must equal full attention computed locally from host arrays
+ref_host = np.asarray(gather_to_host(ref))
+full = np.asarray(_xla_reference(
+    jax.numpy.asarray(q), jax.numpy.asarray(k), jax.numpy.asarray(v),
+    None, jax.numpy.float32,
+))
+np.testing.assert_allclose(ref_host, full, atol=1e-5)
+ring_sum = float(np.asarray(out_host, dtype=np.float64).sum())
+
 barrier("mp_test")
-print(f"WORKER_OK rank={rank} devices={n} mean={val}", flush=True)
+print(f"WORKER_OK rank={rank} devices={n} mean={val} ring={ring_sum:.6f}", flush=True)
 """
 
 
@@ -114,9 +145,16 @@ def test_two_process_bootstrap_and_collective(tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
 
-    for rank, (p, out) in enumerate(_run_world(script, tmp_path, timeout=180)):
+    suffixes = []
+    for rank, (p, out) in enumerate(_run_world(script, tmp_path, timeout=300)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
-        assert f"WORKER_OK rank={rank} devices=2" in out, out
+        ok = [l for l in out.splitlines()
+              if l.startswith(f"WORKER_OK rank={rank} devices=2")]
+        assert ok, out
+        suffixes.append(ok[0].split("devices=2 ")[1])
+    # both processes computed identical collective results (mean AND the
+    # cross-process ring-attention checksum)
+    assert suffixes[0] == suffixes[1], suffixes
 
 
 TRAIN_WORKER = r"""
