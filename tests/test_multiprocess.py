@@ -80,6 +80,9 @@ ref = ring_attention(
 assert np.isfinite(np.asarray(out_host)).all()
 # rate=0 path must equal full attention computed locally from host arrays
 ref_host = np.asarray(gather_to_host(ref))
+# ...and the dropout ring must genuinely differ from it (a silent no-op
+# keep-mask under the cross-process shard_map would pass every other check)
+assert not np.allclose(np.asarray(out_host), ref_host)
 full = np.asarray(_xla_reference(
     jax.numpy.asarray(q), jax.numpy.asarray(k), jax.numpy.asarray(v),
     None, jax.numpy.float32,
@@ -146,7 +149,7 @@ def test_two_process_bootstrap_and_collective(tmp_path):
     script.write_text(WORKER)
 
     suffixes = []
-    for rank, (p, out) in enumerate(_run_world(script, tmp_path, timeout=300)):
+    for rank, (p, out) in enumerate(_run_world(script, tmp_path)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
         ok = [l for l in out.splitlines()
               if l.startswith(f"WORKER_OK rank={rank} devices=2")]
