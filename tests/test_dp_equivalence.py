@@ -38,7 +38,7 @@ def _run(trainer):
     return losses, _param_snapshot(trainer.params)
 
 
-def _assert_same_trajectory(a, b, *, rtol=2e-5, atol=2e-6):
+def _assert_same_trajectory(a, b, *, rtol=2e-5, atol=2e-6, params_atol=1e-5):
     losses_a, params_a = a
     losses_b, params_b = b
     assert len(losses_a) == len(losses_b) and len(losses_a) >= 4
@@ -50,7 +50,7 @@ def _assert_same_trajectory(a, b, *, rtol=2e-5, atol=2e-6):
     flat_b = jax.tree_util.tree_leaves(params_b)
     for x, y in zip(flat_a, flat_b):
         np.testing.assert_allclose(
-            x, y, rtol=1e-4, atol=1e-5,
+            x, y, rtol=1e-4, atol=params_atol,
             err_msg="final params diverge across meshes",
         )
 
@@ -93,3 +93,15 @@ def test_dp8_matches_single_device_with_threefry_dropout(tmp_path):
                               dropout=0.1, n_epochs=2,
                               prng_impl="threefry2x32")
     _assert_same_trajectory(_run(dp), _run(single))
+
+
+def test_dp_tp_mesh_matches_single_device(tmp_path):
+    """dp x tp (data:4, model:2): tensor-parallel sharding of the encoder
+    must not change the math either — same trajectory as one device."""
+    dptp, _ = _make_trainer(tmp_path, mesh_spec="data:4,model:2",
+                            dropout=0.0, n_epochs=2)
+    single, _ = _make_trainer(tmp_path, mesh_spec="data:1",
+                              dropout=0.0, n_epochs=2)
+    # params_atol: TP psum reduction reordering shifts near-zero leaves by
+    # ~1e-5 absolute while the loss trajectory stays tight
+    _assert_same_trajectory(_run(dptp), _run(single), params_atol=5e-5)
