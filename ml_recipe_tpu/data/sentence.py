@@ -50,4 +50,6 @@ def split_sentences(text: str) -> List[str]:
     if tail:
         sentences.append(tail)
 
-    return sentences if sentences else [text]
+    # reachable with an empty list only for whitespace-only input (any real
+    # content lands in the tail) — no sentences is the right answer there
+    return sentences

@@ -1,5 +1,8 @@
 """Data-layer tests: preprocessor, chunking, datasets, collate, loaders."""
 
+import json
+from pathlib import Path
+
 import numpy as np
 import pytest
 
@@ -345,3 +348,30 @@ def test_list_dataloader_rebatches(tmp_path):
         assert len(batch) <= 4
         seen += len(batch)
     assert seen == chunks_direct
+
+
+def test_split_sentences_preserves_word_sequence():
+    """The whole data path's offset maps assume sentence splitting never
+    loses, merges, or reorders whitespace-separated words — verified over
+    the committed real-schema NQ fixtures and adversarial punctuation."""
+    fixture = Path(__file__).parent / "fixtures" / "nq_real_schema.jsonl"
+    texts = [json.loads(l)["document_text"] for l in fixture.read_text().splitlines()]
+    texts += [
+        "Dr. Smith met Mrs. Jones at 3 p.m. They talked. <P> New para . </P>",
+        "No. 5 St. John vs. etc. and e.g. i.e. Fig. 3 shows it. Done.",
+        "A single sentence with no terminal punctuation",
+        "Multiple   spaces.  And tabs\tinside. <Table> <Tr> Cell . </Tr> </Table>",
+        "Ends abruptly.",
+        "\"Quoted start.\" 'Another.' (Parenthetical.) [Bracketed.]",
+        "",
+        "   ",
+        "\t\n ",
+    ]
+    for text in texts:
+        sens = split_sentences(text)
+        rejoined = [w for s in sens for w in s.split()]
+        assert rejoined == text.split(), (
+            f"sentence splitting altered the word sequence for {text[:60]!r}"
+        )
+        for s in sens:
+            assert s.strip(), "empty sentence emitted"
