@@ -4,7 +4,7 @@ Round-1 gap: every data test used the synthetic ``helpers.nq_line`` corpus;
 real Kaggle-NQ structure (``<Table>``/``<Tr>`` markup, nested candidates,
 multiple long-answer candidates, absent annotations, yes/no, multi-answer
 annotations) had never passed through the preprocessor. The committed
-``fixtures/nq_real_schema.jsonl`` carries 10 structurally faithful lines
+``fixtures/nq_real_schema.jsonl`` carries 11 structurally faithful lines
 (int64 example_ids, annotation_id, top_level flags — the simplified TF2.0-QA
 schema, reference split_dataset.py:74-122); these tests pin target
 extraction, o2t/t2o offset maps, window mapping, and chunk-span content
@@ -66,6 +66,10 @@ EXPECTED = {
     9038743322117073437: ("short", "476 AD"),
     7212931760137927035: ("short", "Radon"),
     1530983207262171952: ("short", "Amazon River"),
+    # dev-style multi-annotation line: extraction must use annotations[0]
+    # (reference split_dataset.py:85) — '8848 metres', NOT the second
+    # annotator's 'highest mountain'
+    6644332211009988776: ("short", "8848 metres"),
 }
 
 
@@ -92,14 +96,14 @@ def test_target_extraction_against_document_text():
 def test_label_distribution_and_stratified_split(prep):
     _, counter, labels, (tr_i, tr_l, te_i, te_l), _ = prep
     ids = RawPreprocessor.labels2id
-    assert counter[ids["short"]] == 6
+    assert counter[ids["short"]] == 7
     assert counter[ids["yes"]] == 1
     assert counter[ids["no"]] == 1
     assert counter[ids["long"]] == 1
     assert counter[ids["unknown"]] == 1
     # split covers every example exactly once, stratified per class
     all_idx = sorted(np.concatenate([tr_i, te_i]).tolist())
-    assert all_idx == list(range(10))
+    assert all_idx == list(range(11))
     for idx, lab in zip(np.concatenate([tr_i, te_i]),
                         np.concatenate([tr_l, te_l])):
         assert labels[int(idx)] == lab
@@ -223,12 +227,12 @@ def test_table_markup_span_mapping(prep, tmp_path):
 
 
 def test_split_dataset_samples_consistent_items(prep, tmp_path):
-    """Weighted-sampling train dataset over all 10 real-schema lines: every
+    """Weighted-sampling train dataset over all 11 real-schema lines: every
     emitted item is internally consistent (span content matches its label)."""
     pp, _, _, _, out = prep
     tok = Tokenizer("bert", _full_vocab_file(tmp_path), lowercase=True)
     ds = SplitDataset(
-        out / "proc", tok, np.arange(10),
+        out / "proc", tok, np.arange(11),
         max_seq_len=64, max_question_len=16, doc_stride=24,
         split_by_sentence=False, rng=np.random.default_rng(0),
     )
@@ -256,7 +260,7 @@ def test_sentence_mode_with_truncation(prep, tmp_path):
     pp, _, _, _, out = prep
     tok = Tokenizer("bert", _full_vocab_file(tmp_path), lowercase=True)
     ds = ChunkDataset(
-        out / "proc", tok, np.arange(10),
+        out / "proc", tok, np.arange(11),
         max_seq_len=64, max_question_len=16,
         split_by_sentence=True, truncate=True,
     )
