@@ -239,3 +239,32 @@ def test_inference_notebook_executes(e2e, monkeypatch):
 
     predictor = ns["predictor"]
     assert predictor.scores, "notebook predictor produced no candidates"
+
+
+def test_bench_infer_mode_smoke():
+    """bench.py --mode infer (the driver only exercises train mode): tiny
+    bert-tiny config on the CPU mesh must produce the JSON contract line."""
+    import json
+    import os
+    import subprocess
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    out = subprocess.run(
+        [
+            sys.executable, str(repo / "bench.py"), "--mode", "infer",
+            "--model", "bert-tiny", "--seq_len", "64", "--doc_stride", "32",
+            "--global_batch", "16", "--window", "1",
+            "--infer_docs", "6", "--infer_doc_len", "300", "--infer_jobs", "2",
+        ],
+        cwd=str(repo),
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+    rec = json.loads(line)
+    assert rec["unit"] == "chunks/sec/chip"
+    assert rec["value"] > 0
+    assert rec["docs"] == 6
+    assert rec["chunks"] >= rec["docs"]  # long docs expand to >= 1 chunk each
