@@ -371,7 +371,11 @@ def get_trainer_parser() -> ConfigArgumentParser:
                              "directory (each host saves only the array "
                              "shards it owns) instead of gathering the full "
                              "state for one single-file write. Restore "
-                             "auto-detects either layout.")
+                             "auto-detects either layout and works across "
+                             "topology changes (save at world N, restore at "
+                             "world M), but reassembles the full state on "
+                             "each host — the no-gather memory bound applies "
+                             "to saves only.")
     parser.add_argument("--sync_bn", action="store_true",
                         help="Cross-replica normalization statistics sync (reference "
                              "SyncBN flag; BERT has LayerNorm so this is a no-op "

@@ -196,11 +196,73 @@ def make_global_array(
     return jax.tree_util.tree_map(to_global, host_batch)
 
 
+def _local_cover_shards(x) -> Optional[dict]:
+    """``{bounds: shard}`` for a de-duplicated set of addressable shards that
+    covers every element of ``x``, or None when the local shards don't cover
+    the array (i.e. some data lives only on other hosts)."""
+    total = int(np.prod(x.shape, dtype=np.int64)) if x.shape else 1
+    seen: dict = {}
+    covered = 0
+    for sh in x.addressable_shards:
+        bounds = tuple(
+            (int(s.start or 0), int(s.stop if s.stop is not None else dim))
+            for s, dim in zip(sh.index, x.shape)
+        )
+        if bounds in seen:
+            continue
+        seen[bounds] = sh
+        vol = int(np.prod([b - a for a, b in bounds], dtype=np.int64)) if bounds else 1
+        covered += vol
+    if covered != total:
+        return None
+    return seen
+
+
+def local_host_copy(x) -> Optional[np.ndarray]:
+    """Full host numpy copy of ``x`` assembled from addressable shards only —
+    no collectives. Returns None when local shards don't cover the array.
+
+    Replicated (and host-locally-sharded) arrays are fully reconstructable on
+    every host, so gathering them never needs ``process_allgather``; that is
+    what lets non-writing hosts skip checkpoint gathers entirely."""
+    shards = _local_cover_shards(x)
+    if shards is None:
+        return None
+    out = np.empty(x.shape, dtype=x.dtype)
+    for bounds, sh in shards.items():
+        idx = tuple(slice(a, b) for a, b in bounds)
+        out[idx] = np.asarray(sh.data)
+    return out
+
+
+def needs_collective_gather(tree) -> bool:
+    """True when gathering ``tree`` to host requires a cross-host collective
+    (some leaf's data lives only on other hosts). With the standard symmetric
+    NamedShardings every process computes the same answer, so it can gate who
+    participates in :func:`gather_to_host`."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if (
+            isinstance(leaf, jax.Array)
+            and not leaf.is_fully_addressable
+            and _local_cover_shards(leaf) is None
+        ):
+            return True
+    return False
+
+
 def gather_to_host(tree):
-    """Device tree (possibly multi-host-sharded) -> full host numpy tree."""
+    """Device tree (possibly multi-host-sharded) -> full host numpy tree.
+
+    Per-leaf strategy: fully-addressable -> plain device_get; replicated /
+    locally-coverable -> assemble from addressable shards (no collective);
+    genuinely cross-host-sharded -> ``process_allgather`` (collective — every
+    process must call this function with the same tree)."""
 
     def gather(x):
         if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            local = local_host_copy(x)
+            if local is not None:
+                return local
             from jax.experimental import multihost_utils
 
             return np.asarray(multihost_utils.process_allgather(x, tiled=True))

@@ -28,8 +28,14 @@ from flax import serialization
 from flax.traverse_util import empty_node, flatten_dict, unflatten_dict
 
 from ..parallel.sharding import gather_to_host as _to_host
+from ..parallel.sharding import needs_collective_gather
 
 logger = logging.getLogger(__name__)
+
+
+class TornCheckpointError(RuntimeError):
+    """A sharded checkpoint directory is internally inconsistent (a save was
+    interrupted mid-write, or shard files are missing)."""
 
 
 def save_state_dict(
@@ -48,6 +54,15 @@ def save_state_dict(
     key so checkpoints stay structurally loadable when --apex_loss_scale
     changes between save and resume.
     """
+    # Non-writing hosts do the gather ONLY when a leaf genuinely needs a
+    # cross-host collective (e.g. ZeRO-sharded opt state without
+    # --sharded_checkpoint). Replicated states are assembled from local
+    # shards by the primary alone — no all-host materialization.
+    if not is_primary and not needs_collective_gather(
+        (params, opt_state, loss_scale)
+    ):
+        return
+
     state = {
         "model": serialization.to_state_dict(_to_host(params)),
         "optimizer": (
@@ -99,6 +114,42 @@ def _atomic_write(path: str, blob: bytes) -> None:
     os.replace(tmp, path)
 
 
+def _recover_interrupted_swap(path: str, staging: str, old: str) -> None:
+    """Finish a sharded-save swap that died between its two renames.
+
+    The swap is rename(path -> old) then rename(staging -> path): a crash in
+    the window leaves NO live checkpoint at ``path`` while a complete one
+    sits in ``staging`` (its manifest is written last, so manifest presence
+    means complete) and the previous good one in ``old``. Roll forward to
+    the staged checkpoint when it is complete, else roll back to ``old`` —
+    never treat either as deletable debris while ``path`` is missing.
+    Concurrent callers may race the renames on a shared filesystem: a loser
+    sees FileNotFoundError (source already moved) or ENOTEMPTY (target
+    already repopulated) — both mean another process recovered first, which
+    the re-check of ``path`` confirms.
+    """
+    if os.path.exists(path):
+        return
+    try:
+        if os.path.isdir(staging) and os.path.exists(
+            os.path.join(staging, _MANIFEST)
+        ):
+            os.rename(staging, path)
+            logger.warning(
+                f"Recovered interrupted sharded save: completed staged "
+                f"checkpoint {staging} promoted to {path}."
+            )
+        elif os.path.exists(old):
+            os.rename(old, path)
+            logger.warning(
+                f"Recovered interrupted sharded save: previous checkpoint "
+                f"{old} restored to {path}."
+            )
+    except OSError:  # lost a recovery race?
+        if not os.path.exists(path):
+            raise
+
+
 def _flat_state(tree) -> dict:
     """State-dict tree flattened to ``{'a/b/c': leaf}`` (leaves untouched —
     jax.Arrays keep their shardings). Empty subtrees (optax EmptyState
@@ -135,6 +186,13 @@ def save_state_dict_sharded(
           manifest.msgpack          # format tag, step, leaf shapes/dtypes
           shard-00000.msgpack       # this process's owned shards
           shard-00001.msgpack       # (one file per process)
+
+    Atomicity: shards are written into a fresh sibling directory
+    (``path + '.saving'``); after a cross-process barrier confirms every
+    shard file landed, the primary writes the manifest LAST (manifest
+    presence therefore implies a complete checkpoint) and swaps the new
+    directory in. An interruption at any point leaves the previous good
+    checkpoint at ``path`` untouched.
     """
     import jax
 
@@ -148,19 +206,28 @@ def save_state_dict_sharded(
             f"checkpoint path {path} is a non-empty directory that is not a "
             f"sharded checkpoint; refusing to write into it"
         )
-    if os.path.isfile(path):
-        # a single-file checkpoint previously lived at this name (the flag
-        # was toggled on mid-experiment); replace it with the directory.
-        # Barrier afterwards: on a shared filesystem another process must
-        # not hit makedirs while the file still exists (exist_ok only
-        # forgives existing DIRECTORIES).
-        if jax.process_index() == 0:
-            os.remove(path)
+
+    def _barrier(tag: str) -> None:
         if jax.process_count() > 1:
             from ..parallel import barrier
 
-            barrier("sharded_ckpt_clear")
-    os.makedirs(path, exist_ok=True)
+            barrier(tag)
+
+    # stage everything in a sibling directory; the live path is only touched
+    # in the final swap
+    staging = path + ".saving"
+    old = path + ".old"
+    if jax.process_index() == 0:
+        import shutil
+
+        _recover_interrupted_swap(path, staging, old)
+        for leftover in (staging, old):  # debris from an interrupted save
+            if os.path.isdir(leftover):
+                shutil.rmtree(leftover)
+            elif os.path.isfile(leftover):
+                os.remove(leftover)
+    _barrier("sharded_ckpt_stage_clear")
+    os.makedirs(staging, exist_ok=True)
 
     groups = {"model": params}
     if opt_state is not None:
@@ -216,23 +283,42 @@ def save_state_dict_sharded(
                 )
         manifest["groups"][gname] = leaves_meta
 
-    # each shard file carries the step so the loader can detect a torn save
-    # (per-file writes are atomic, the directory as a whole is not)
-    shard_file = os.path.join(path, f"shard-{jax.process_index():05d}.msgpack")
+    # each shard file still carries the step as defense-in-depth torn-save
+    # detection (e.g. a checkpoint directory assembled by hand)
+    shard_file = os.path.join(staging, f"shard-{jax.process_index():05d}.msgpack")
     _atomic_write(
         shard_file,
         serialization.msgpack_serialize(
             {"global_step": int(global_step), "shards": owned}
         ),
     )
+    # all shard files must land before the manifest exists anywhere
+    _barrier("sharded_ckpt_shards_written")
     if jax.process_index() == 0:
+        import shutil
+
         _atomic_write(
-            os.path.join(path, _MANIFEST),
+            os.path.join(staging, _MANIFEST),
             serialization.msgpack_serialize(manifest),
         )
+        # swap the complete staging dir in; the previous checkpoint (file or
+        # directory) is only removed after the new one is fully in place
+        if os.path.isfile(path):
+            # single-file checkpoint previously at this name (the flag was
+            # toggled on mid-experiment)
+            os.replace(path, old)
+        elif os.path.isdir(path):
+            os.rename(path, old)
+        os.rename(staging, path)
+        if os.path.isdir(old):
+            shutil.rmtree(old)
+        elif os.path.isfile(old):
+            os.remove(old)
+    # peers may act on the checkpoint (upload, teardown) once the swap landed
+    _barrier("sharded_ckpt_swapped")
     logger.info(
         f"Sharded state dict: process {jax.process_index()} wrote its shards "
-        f"to {shard_file}."
+        f"to {os.path.join(path, os.path.basename(shard_file))}."
     )
 
 
@@ -266,20 +352,23 @@ def load_state_dict_sharded(
         os.path.join(path, f"shard-{p:05d}.msgpack") for p in range(n_proc)
     ]
     for f in shard_files:
-        assert os.path.exists(f), f"sharded checkpoint missing {f}"
+        if not os.path.exists(f):
+            raise TornCheckpointError(f"sharded checkpoint missing {f}")
 
     assembled: dict = {g: {} for g in manifest["groups"]}
     filled: dict = {g: {} for g in manifest["groups"]}
     for f in shard_files:
         with open(f, "rb") as fh:
             data = serialization.msgpack_restore(fh.read())
-        # torn-save detection: every shard must carry the manifest's step
-        # (per-file writes are atomic; the directory as a whole is not)
-        assert int(data["global_step"]) == int(manifest["global_step"]), (
-            f"sharded checkpoint is torn: {f} holds step "
-            f"{data['global_step']}, manifest holds {manifest['global_step']}"
-            f" — a save was interrupted mid-write; use an epoch checkpoint"
-        )
+        # defense-in-depth torn-save detection: every shard must carry the
+        # manifest's step (the staged-dir + swap save makes this unreachable
+        # for our own saves; hand-assembled directories can still trip it)
+        if int(data["global_step"]) != int(manifest["global_step"]):
+            raise TornCheckpointError(
+                f"sharded checkpoint is torn: {f} holds step "
+                f"{data['global_step']}, manifest holds "
+                f"{manifest['global_step']} — a save was interrupted mid-write"
+            )
         for gname, leaves in data["shards"].items():
             for key, shards in leaves.items():
                 meta = manifest["groups"][gname][key]
@@ -302,10 +391,11 @@ def load_state_dict_sharded(
                 continue
             want = int(np.prod(meta["shape"], dtype=np.int64)) if meta["shape"] else 1
             got = filled[gname].get(key, 0)
-            assert got == want, (
-                f"sharded checkpoint incomplete: {gname}/{key} has {got} of "
-                f"{want} elements (missing shard files?)"
-            )
+            if got != want:
+                raise TornCheckpointError(
+                    f"sharded checkpoint incomplete: {gname}/{key} has {got} "
+                    f"of {want} elements (missing shard files?)"
+                )
 
     def _restore(target, gname):
         flat = dict(assembled[gname])
@@ -373,6 +463,10 @@ def load_state_dict(
     """
     path = os.fspath(path)
     if not os.path.exists(path):
+        # a sharded save interrupted mid-swap may have left the checkpoint
+        # in its staging/old sibling — roll it forward/back before giving up
+        _recover_interrupted_swap(path, path + ".saving", path + ".old")
+    if not os.path.exists(path):
         logger.warning(f"Checkpoint {path} does not exist, so checkpoint was not loaded.")
         return params, opt_state, loss_scale, None
 
@@ -389,13 +483,22 @@ def load_state_dict(
                 f"first sharded save?); checkpoint was not loaded."
             )
             return params, opt_state, loss_scale, None
-        return load_state_dict_sharded(
-            path,
-            params=params,
-            opt_state=opt_state,
-            loss_scale=loss_scale,
-            drop_optimizer=drop_optimizer,
-        )
+        try:
+            return load_state_dict_sharded(
+                path,
+                params=params,
+                opt_state=opt_state,
+                loss_scale=loss_scale,
+                drop_optimizer=drop_optimizer,
+            )
+        except TornCheckpointError as exc:
+            # same warn-and-continue contract the single-file path gets from
+            # os.replace atomicity (reference trainer.py:381-385): a damaged
+            # --last checkpoint must not crash resume — start fresh / from an
+            # epoch checkpoint instead. Direct load_state_dict_sharded
+            # callers still see the hard error.
+            logger.warning(f"Checkpoint {path} was not loaded: {exc}")
+            return params, opt_state, loss_scale, None
 
     with open(path, "rb") as fh:
         state = serialization.msgpack_restore(fh.read())

@@ -101,21 +101,22 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_world(script, tmp_path, *, extra_env=None, timeout=300, attempts=3):
-    """Spawn a 2-process world on a fresh port; retry on port-steal races
+def _run_world(script, tmp_path, *, extra_env=None, timeout=300, attempts=3,
+               world=2):
+    """Spawn an N-process world on a fresh port; retry on port-steal races
     (the port is released before the rank-0 coordinator binds it)."""
     last = None
     for _ in range(attempts):
         port = _free_port()
         procs = []
-        for rank in range(2):
+        for rank in range(world):
             env = dict(os.environ)
             env.update(
                 REPO_ROOT=str(REPO),
                 WORK_DIR=str(tmp_path),
                 MASTER_IP="127.0.0.1",
                 MASTER_PORT=str(port),
-                WORLD_SIZE="2",
+                WORLD_SIZE=str(world),
                 LOCAL_RANK=str(rank),
                 JAX_PLATFORMS="cpu",
             )
@@ -272,6 +273,224 @@ def test_two_process_training_replicas_agree(tmp_path):
     # both replicas trained the same trajectory: same step, loss, checksum
     assert lines[0].split("rank=0 ")[1] == lines[1].split("rank=1 ")[1], lines
     assert (tmp_path / "mp_last.ch").exists()  # primary-only checkpoint write
+
+
+RESTORE_WORKER = r"""
+import os, sys
+sys.path.insert(0, os.environ["REPO_ROOT"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from ml_recipe_tpu.data.collate import make_collate_fun
+from ml_recipe_tpu.data.datasets import DummyDataset
+from ml_recipe_tpu.losses import build_loss
+from ml_recipe_tpu.models import EncoderConfig, QAModel
+from ml_recipe_tpu.parallel import build_mesh, initialize_from_env
+from ml_recipe_tpu.parallel.sharding import gather_to_host
+from ml_recipe_tpu.tokenizer import Tokenizer
+from ml_recipe_tpu.train import Trainer
+
+initialize_from_env()
+
+tok = Tokenizer("bert", os.path.join(os.environ["WORK_DIR"], "vocab.txt"))
+
+class TP:
+    loss = "ce"; smooth_alpha = 0.01; focal_alpha = 1; focal_gamma = 2
+    w_start = 1; w_end = 1; w_start_reg = 0.5; w_end_reg = 0.5; w_cls = 1
+    lr = 1e-3; weight_decay = 0.01; warmup_coef = 0.0
+    optimizer = "adam"; finetune = False
+
+rng = np.random.default_rng(0)
+tr = DummyDataset(tokenizer=tok, max_seq_len=48, max_question_len=12,
+                  dataset_len=32, rng=rng)
+
+cfg = EncoderConfig(vocab_size=len(tok), hidden_size=16, num_layers=2,
+                    num_heads=2, intermediate_size=32,
+                    max_position_embeddings=50, num_labels=5,
+                    hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+model = QAModel(cfg)
+# fresh weights (key 2): equality below proves RESTORE, not retention
+fresh = model.init(jax.random.key(2),
+                   np.asarray(tr[0].input_ids, np.int32)[None, :])["params"]
+t = Trainer(model=model, params=fresh, loss=build_loss(TP()),
+            collate_fun=make_collate_fun(tok, max_seq_len=48),
+            trainer_params=TP(), train_dataset=tr,
+            mesh=build_mesh(), n_epochs=1, train_batch_size=16,
+            batch_split=2, n_jobs=0, warmup_coef=0.0, max_grad_norm=1.0,
+            seed=0, shard_optimizer=True, zero_min_size=0,
+            sharded_checkpoint=True)
+t.load_state_dict(os.path.join(os.environ["WORK_DIR"], "mp_last.ch"))
+leaves = jax.tree_util.tree_leaves(gather_to_host(t.params))
+checksum = float(sum(np.asarray(l, dtype=np.float64).sum() for l in leaves))
+opt_leaves = jax.tree_util.tree_leaves(gather_to_host(t.opt_state))
+opt_checksum = float(
+    sum(np.asarray(l, dtype=np.float64).sum() for l in opt_leaves))
+print(f"RESTORE_OK rank={jax.process_index()} world={jax.process_count()} "
+      f"step={t.global_step} checksum={checksum:.6f} "
+      f"opt={opt_checksum:.6f}", flush=True)
+"""
+
+
+def test_sharded_checkpoint_topology_change(tmp_path):
+    """VERDICT r2 missing #3 (pod resize / preemption recovery): a
+    --sharded_checkpoint written at world 2 must restore at world 1 and at
+    world 4 — onto fresh-initialized trainers with ZeRO sharding — with
+    params and optimizer state equal to what world 2 trained."""
+    script = tmp_path / "train_worker.py"
+    script.write_text(TRAIN_WORKER)
+
+    train_lines = []
+    for rank, (p, out) in enumerate(
+        _run_world(script, tmp_path, extra_env={"SHARDED_CKPT": "1"})
+    ):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        ok = [l for l in out.splitlines() if l.startswith("TRAIN_OK")]
+        assert ok, out
+        train_lines.append(ok[0])
+    want_step = train_lines[0].split("step=")[1].split()[0]
+    want_checksum = train_lines[0].split("checksum=")[1].split()[0]
+
+    restore = tmp_path / "restore_worker.py"
+    restore.write_text(RESTORE_WORKER)
+    for world in (1, 4):
+        lines = []
+        for rank, (p, out) in enumerate(
+            _run_world(restore, tmp_path, world=world)
+        ):
+            assert p.returncode == 0, f"world={world} rank {rank}:\n{out}"
+            ok = [l for l in out.splitlines() if l.startswith("RESTORE_OK")]
+            assert ok, out
+            lines.append(ok[0])
+        opts = set()
+        for line in lines:
+            assert f"world={world}" in line, line
+            assert f"step={want_step}" in line, (line, want_step)
+            got = line.split("checksum=")[1].split()[0]
+            assert abs(float(got) - float(want_checksum)) < 1e-4, (
+                line, want_checksum,
+            )
+            opts.add(line.split("opt=")[1])
+        assert len(opts) == 1, lines  # every rank restored the same opt state
+
+
+SIGTERM_WORKER = r"""
+import os, signal, sys, threading
+sys.path.insert(0, os.environ["REPO_ROOT"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from ml_recipe_tpu.data.collate import make_collate_fun
+from ml_recipe_tpu.data.datasets import DummyDataset
+from ml_recipe_tpu.losses import build_loss
+from ml_recipe_tpu.models import EncoderConfig, QAModel
+from ml_recipe_tpu.parallel import build_mesh, initialize_from_env, is_primary
+from ml_recipe_tpu.parallel.sharding import gather_to_host
+from ml_recipe_tpu.tokenizer import Tokenizer
+from ml_recipe_tpu.train import Trainer
+
+initialize_from_env()
+
+vocab = os.path.join(os.environ["WORK_DIR"], "vocab.txt")
+if is_primary():
+    with open(vocab + ".tmp", "w") as f:
+        f.write("\n".join(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+                          + [f"tok{i}" for i in range(45)]))
+    os.replace(vocab + ".tmp", vocab)
+from ml_recipe_tpu.parallel import barrier
+barrier("vocab")
+tok = Tokenizer("bert", vocab)
+
+class TP:
+    loss = "ce"; smooth_alpha = 0.01; focal_alpha = 1; focal_gamma = 2
+    w_start = 1; w_end = 1; w_start_reg = 0.5; w_end_reg = 0.5; w_cls = 1
+    lr = 1e-3; weight_decay = 0.01; warmup_coef = 0.0
+    optimizer = "adam"; finetune = False
+
+def make_trainer(key):
+    rng = np.random.default_rng(0)
+    tr = DummyDataset(tokenizer=tok, max_seq_len=48, max_question_len=12,
+                      dataset_len=32, rng=rng)
+    cfg = EncoderConfig(vocab_size=len(tok), hidden_size=16, num_layers=2,
+                        num_heads=2, intermediate_size=32,
+                        max_position_embeddings=50, num_labels=5,
+                        hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+    model = QAModel(cfg)
+    params = model.init(jax.random.key(key),
+                        np.asarray(tr[0].input_ids, np.int32)[None, :])["params"]
+    return Trainer(model=model, params=params, loss=build_loss(TP()),
+                   collate_fun=make_collate_fun(tok, max_seq_len=48),
+                   trainer_params=TP(), train_dataset=tr,
+                   mesh=build_mesh(), n_epochs=3, train_batch_size=16,
+                   batch_split=2, n_jobs=0, warmup_coef=0.0,
+                   max_grad_norm=1.0, seed=0, shard_optimizer=True,
+                   zero_min_size=0, sharded_checkpoint=True)
+
+t = make_trainer(0)
+
+# the cli.train wiring (cli/train.py): SIGTERM -> KeyboardInterrupt -> the
+# except branch saves interrupt.ch through the ordinary (here: sharded)
+# checkpoint path. Every process delivers ITSELF the signal after epoch 1,
+# the same shape a pod preemption takes.
+def on_sigterm(signum, frame):
+    raise KeyboardInterrupt
+
+signal.signal(signal.SIGTERM, on_sigterm)
+
+def preempt(epoch_i):
+    if epoch_i == 1:
+        os.kill(os.getpid(), signal.SIGTERM)
+
+ckpt = os.path.join(os.environ["WORK_DIR"], "interrupt.ch")
+try:
+    t.train(after_epoch_funcs=[preempt])
+    raise AssertionError("SIGTERM did not interrupt training")
+except KeyboardInterrupt:
+    signal.signal(signal.SIGTERM, signal.SIG_IGN)
+    t.save_state_dict(ckpt)
+
+step_at_interrupt = t.global_step
+interrupted = gather_to_host(t.params)
+
+# resume in a FRESH trainer (different init key), continue one more epoch
+t2 = make_trainer(1)
+t2.load_state_dict(ckpt)
+assert t2.global_step == step_at_interrupt, (t2.global_step, step_at_interrupt)
+for a, b in zip(jax.tree_util.tree_leaves(interrupted),
+                jax.tree_util.tree_leaves(gather_to_host(t2.params))):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+t2.n_epochs = 1
+t2.train()
+assert t2.global_step == step_at_interrupt + len(t2.train_dataloader)
+
+leaves = jax.tree_util.tree_leaves(gather_to_host(t2.params))
+checksum = float(sum(np.asarray(l, dtype=np.float64).sum() for l in leaves))
+print(f"SIGTERM_OK rank={jax.process_index()} step={t2.global_step} "
+      f"checksum={checksum:.6f}", flush=True)
+"""
+
+
+def test_two_process_sigterm_sharded_save_resume(tmp_path):
+    """VERDICT r2 #4 (second half): SIGTERM mid-training on BOTH processes
+    routes into a sharded interrupt checkpoint (cross-process barriers and
+    atomic directory swap included), and a fresh 2-process world resumes
+    from it and keeps training."""
+    script = tmp_path / "sigterm_worker.py"
+    script.write_text(SIGTERM_WORKER)
+
+    lines = []
+    for rank, (p, out) in enumerate(_run_world(script, tmp_path)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        ok = [l for l in out.splitlines() if l.startswith("SIGTERM_OK")]
+        assert ok, out
+        lines.append(ok[0])
+    # both replicas resumed the same trajectory
+    assert lines[0].split("rank=0 ")[1] == lines[1].split("rank=1 ")[1], lines
+    assert (tmp_path / "interrupt.ch").is_dir()
 
 
 def test_two_process_sharded_checkpoint(tmp_path):

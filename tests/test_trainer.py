@@ -699,3 +699,62 @@ def test_sharded_checkpoint_tp_mesh_roundtrip(tmp_path):
         jax.tree_util.tree_leaves(_param_snapshot(t2.params)),
     ):
         np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_sharded_save_interrupted_swap_recovery(tmp_path):
+    """A sharded save that dies between the swap's two renames leaves no
+    checkpoint at the live path; both the next load AND the next save must
+    roll the staged/old sibling forward or back instead of treating it as
+    deletable debris (round-3 review finding)."""
+    import os
+    import shutil
+
+    t, _ = _make_trainer(tmp_path, dropout=0.0)
+    t.sharded_checkpoint = True
+    t.train()
+    ckpt = tmp_path / "swap.ckpt"
+    t.save_state_dict(ckpt)
+    want = _param_snapshot(t.params)
+
+    def fresh():
+        (tmp_path / "fresh").mkdir(exist_ok=True)
+        t2, _ = _make_trainer(tmp_path / "fresh", dropout=0.0)
+        t2.sharded_checkpoint = True
+        return t2
+
+    # crash AFTER rename(path -> old), BEFORE rename(staging -> path), with
+    # the staged save COMPLETE (manifest written last => present): roll
+    # forward to the staged checkpoint
+    shutil.copytree(ckpt, str(ckpt) + ".saving")
+    os.rename(ckpt, str(ckpt) + ".old")
+    t2 = fresh()
+    t2.load_state_dict(ckpt)
+    assert t2.global_step == t.global_step
+    for a, b in zip(
+        jax.tree_util.tree_leaves(want),
+        jax.tree_util.tree_leaves(_param_snapshot(t2.params)),
+    ):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+    assert ckpt.is_dir() and not os.path.exists(str(ckpt) + ".saving")
+    # load-side recovery restores the live path only; the stale .old is the
+    # next save's to clean
+    shutil.rmtree(str(ckpt) + ".old")
+
+    # crash BEFORE the staged manifest landed: only the old checkpoint is
+    # complete -> roll back to it
+    (ckpt / "manifest.msgpack").rename(tmp_path / "stash.msgpack")
+    os.rename(ckpt, str(ckpt) + ".saving")  # incomplete staging
+    shutil.copytree(str(ckpt) + ".saving", str(ckpt) + ".old")
+    (tmp_path / "stash.msgpack").rename(
+        str(ckpt) + ".old/manifest.msgpack"
+    )
+    t3 = fresh()
+    t3.load_state_dict(ckpt)
+    assert t3.global_step == t.global_step
+
+    # and the next SAVE after such a crash recovers first, then overwrites
+    os.rename(ckpt, str(ckpt) + ".old")
+    t3.save_state_dict(ckpt)
+    assert ckpt.is_dir() and (ckpt / "manifest.msgpack").exists()
+    assert not os.path.exists(str(ckpt) + ".old")
+    assert not os.path.exists(str(ckpt) + ".saving")
