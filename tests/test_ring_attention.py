@@ -192,6 +192,70 @@ def test_ring_dropout_gradients_flow():
     assert abs(analytic - numeric) < 1e-2 * max(1.0, abs(numeric))
 
 
+def test_ring_custom_backward_matches_autodiff():
+    """The blockwise-recompute VJP must produce the same (dq, dk, dv) as
+    plain autodiff through the ring loop — with and without dropout (the
+    autodiff path differentiates through the identical recomputed keep
+    masks, so it is an exact oracle, not a statistical one)."""
+    mesh = build_mesh("seq:4")
+    q, k, v = _qkv(L=32)
+    mask = np.ones((2, 32), np.int32)
+    mask[0, 20:] = 0
+    mask = jnp.asarray(mask)
+
+    for rate, seed in ((0.0, None), (0.3, jnp.asarray([42], jnp.int32))):
+        def loss(custom):
+            def f(q_, k_, v_):
+                out = ring_attention(
+                    q_, k_, v_, mask, mesh=mesh, rate=rate, seed=seed,
+                    custom_backward=custom,
+                )
+                return jnp.sum(out ** 2)
+            return f
+
+        g_custom = jax.grad(loss(True), argnums=(0, 1, 2))(q, k, v)
+        g_auto = jax.grad(loss(False), argnums=(0, 1, 2))(q, k, v)
+        for gc, ga, name in zip(g_custom, g_auto, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(gc), np.asarray(ga), atol=2e-4,
+                err_msg=f"d{name} (rate={rate})",
+            )
+
+
+@pytest.mark.slow
+def test_ring_custom_backward_memory_bounded():
+    """VERDICT r2 #3 evidence: at L=4096 on a seq:4 mesh the custom VJP's
+    compiled temp memory must be far below plain autodiff's (which saves
+    every ring step's [B, H, L_loc, L_loc] probability block). Measured on
+    this shape: ~69 MB vs ~184 MB total; the custom path holds ~one
+    recompute scratch block per device regardless of ring size."""
+    mesh = build_mesh("seq:4")
+    B, L, H, D = 1, 4096, 4, 16
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(B, L, H, D)), jnp.float32)
+
+    def temp_bytes(custom):
+        def loss(q, k, v):
+            return jnp.sum(
+                ring_attention(q, k, v, mesh=mesh, custom_backward=custom) ** 2
+            )
+
+        compiled = (
+            jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(x, x, x).compile()
+        )
+        return compiled.memory_analysis().temp_size_in_bytes
+
+    custom, auto = temp_bytes(True), temp_bytes(False)
+    # the custom path must beat autodiff by at least 2x at 4 shards (the
+    # gap widens with ring size: one scratch block vs n_shards saved blocks)
+    assert custom * 2 < auto, (custom, auto)
+    # and stay within ~2 scratch blocks + residuals per device in absolute
+    # terms: block = H * L_loc^2 * 4B = 16.8 MB at this shape
+    n_shards = 4
+    block = H * (L // n_shards) ** 2 * 4
+    assert custom < n_shards * 2.5 * block, (custom, block)
+
+
 def test_ring_dropout_composes_with_data_axis():
     """dp x sp: the batch_axis seed-fold decorrelates data-parallel groups
     while keeping seq-shard-count invariance (same seed, data:2 mesh with
