@@ -103,7 +103,7 @@ def _softmax_probs(q, k, mask, scale):
 
 
 def _fused_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, o_ref,
-                      *, scale: float, rate: float, heads: int, hc: int,
+                      *, scale: float, rate: float, hc: int,
                       D: int):
     """One (batch, head-group) program: softmax(q k^T / sqrt(d)) v for ``hc``
     heads, with optional attention-probs dropout, fully in VMEM. Operands
@@ -125,7 +125,7 @@ def _fused_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, o_ref,
         p = _softmax_probs(q, k, mask, scale)
 
         if rate > 0.0:
-            u = _uniform_grid(seed_ref[0], b * heads + hj * hc + h, q.shape[0])
+            u = _uniform_grid(seed_ref[b], hj * hc + h, q.shape[0])
             p = jnp.where(u >= rate, p * (1.0 / (1.0 - rate)), 0.0)
 
         o = jax.lax.dot_general(
@@ -178,7 +178,7 @@ def _attention_bwd_math(q, k, v, g, mask, scale, *, drop=None):
 
 def _fused_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
                       dq_ref, dk_ref, dv_ref,
-                      *, scale: float, rate: float, heads: int, hc: int,
+                      *, scale: float, rate: float, hc: int,
                       D: int):
     """One (batch, head-group) program: exact attention backward for ``hc``
     heads, recomputing the probabilities (and regenerating the identical
@@ -195,7 +195,7 @@ def _fused_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
         drop = None
         if rate > 0.0:
             keep = _uniform_grid(
-                seed_ref[0], b * heads + hj * hc + h, q.shape[0]
+                seed_ref[b], hj * hc + h, q.shape[0]
             ) >= rate
             drop = (keep, jnp.float32(1.0 / (1.0 - rate)))
 
@@ -208,7 +208,7 @@ def _fused_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
 
 def _blocked_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
                         dq_ref, dk_ref, dv_ref,
-                        *, scale: float, rate: float, heads: int, hc: int,
+                        *, scale: float, rate: float, hc: int,
                         D: int):
     """Fused long-sequence backward: one (batch, head-group, q-block)
     program. The whole K/V for the head group stays resident in VMEM, so
@@ -230,7 +230,7 @@ def _blocked_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
         drop = None
         if rate > 0.0:
             keep = _uniform_grid(
-                seed_ref[0], b * heads + hj * hc + h, L,
+                seed_ref[b], hj * hc + h, L,
                 rows=q_blk, row_offset=qi * q_blk,
             ) >= rate
             drop = (keep, jnp.float32(1.0 / (1.0 - rate)))
@@ -257,7 +257,7 @@ def _blocked_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
 
 
 def _blocked_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, o_ref,
-                        *, scale: float, rate: float, heads: int, hc: int,
+                        *, scale: float, rate: float, hc: int,
                         D: int):
     """One (batch, head-group, q-block) program for longer sequences, with
     optional in-kernel attention-probs dropout (keep-bits keyed by the
@@ -274,7 +274,7 @@ def _blocked_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, o_ref,
         p = _softmax_probs(q, k, mask, scale)
         if rate > 0.0:
             u = _uniform_grid(
-                seed_ref[0], b * heads + hj * hc + h, L,
+                seed_ref[b], hj * hc + h, L,
                 rows=q_blk, row_offset=qi * q_blk,
             )
             p = jnp.where(u >= rate, p * (1.0 / (1.0 - rate)), 0.0)
@@ -304,6 +304,25 @@ def _fold(x):
     bitcast (unlike the [B,H,L,D] relayout, which is a real HBM copy)."""
     B, L, H, D = x.shape
     return x.reshape(B, L, H * D)
+
+
+def _row_seeds(seed, B: int, H: int):
+    """Per-batch-row int32 seed vector for the scalar-prefetch operand.
+
+    Row ``r`` continues the scalar scheme exactly (``seed + r*H*PRIME`` —
+    the old ``(b*heads + h) * PRIME`` fold decomposed), so single-shard
+    masks are bit-identical to the former scalar seeding; but because the
+    kernels key by ``seed_ref[b]``, a batch-sharded execution hands each
+    shard its rows' GLOBAL seeds — data-parallel replicas no longer reuse
+    one mask stream (ADVICE r2: the XLA bernoulli path decorrelates dp
+    groups automatically; this restores that property for the kernels).
+    A caller may also pass a precomputed [B] vector directly (used by tests
+    to emulate a shard-local invocation)."""
+    if seed.shape[0] == B and B > 1:
+        return seed.astype(jnp.int32)
+    return seed[0].astype(jnp.int32) + jax.lax.iota(jnp.int32, B) * (
+        jnp.int32(H) * jnp.int32(-1640531527)
+    )
 
 
 _VMEM_BUDGET = 12 * 1024 * 1024  # leave ~4 MB of the ~16 MB/core for Mosaic
@@ -346,7 +365,7 @@ def _flash_forward(q, k, v, mask, seed, dtype, rate, interpret: bool):
 
     out = pl.pallas_call(
         functools.partial(_fused_fwd_kernel, scale=1.0 / (D ** 0.5),
-                          rate=rate, heads=H, hc=hc, D=D),
+                          rate=rate, hc=hc, D=D),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B, H // hc),
@@ -358,7 +377,7 @@ def _flash_forward(q, k, v, mask, seed, dtype, rate, interpret: bool):
         ),
         out_shape=jax.ShapeDtypeStruct((B, L, H * D), dtype),
         interpret=interpret,
-    )(seed, mask[:, None, :], _fold(q), _fold(k), _fold(v))
+    )(_row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v))
     return out.reshape(B, L, H, D)
 
 
@@ -373,7 +392,7 @@ def _flash_backward(q, k, v, mask, seed, g, dtype, rate, interpret: bool):
 
     dq, dk, dv = pl.pallas_call(
         functools.partial(_fused_bwd_kernel, scale=1.0 / (D ** 0.5),
-                          rate=rate, heads=H, hc=hc, D=D),
+                          rate=rate, hc=hc, D=D),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B, H // hc),
@@ -385,7 +404,8 @@ def _flash_backward(q, k, v, mask, seed, g, dtype, rate, interpret: bool):
         ),
         out_shape=[jax.ShapeDtypeStruct((B, L, H * D), q.dtype)] * 3,
         interpret=interpret,
-    )(seed, mask[:, None, :], _fold(q), _fold(k), _fold(v), _fold(g))
+    )(_row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v),
+      _fold(g))
     return tuple(x.reshape(B, L, H, D) for x in (dq, dk, dv))
 
 
@@ -437,7 +457,7 @@ def _blocked_forward(q, k, v, mask, seed, q_blk, hc, dtype, rate,
     # of re-streaming them L/q_blk times from HBM.
     out = pl.pallas_call(
         functools.partial(_blocked_fwd_kernel, scale=1.0 / (D ** 0.5),
-                          rate=rate, heads=H, hc=hc, D=D),
+                          rate=rate, hc=hc, D=D),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B, H // hc, L // q_blk),
@@ -453,7 +473,7 @@ def _blocked_forward(q, k, v, mask, seed, q_blk, hc, dtype, rate,
         ),
         out_shape=jax.ShapeDtypeStruct((B, L, H * D), dtype),
         interpret=interpret,
-    )(seed, mask[:, None, :], _fold(q), _fold(k), _fold(v))
+    )(_row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v))
     return out.reshape(B, L, H, D)
 
 
@@ -504,7 +524,7 @@ def _blocked_backward(q, k, v, mask, seed, g, q_blk, hc, dtype, rate,
 
     dq, dk, dv = pl.pallas_call(
         functools.partial(_blocked_bwd_kernel, scale=1.0 / (D ** 0.5),
-                          rate=rate, heads=H, hc=hc, D=D),
+                          rate=rate, hc=hc, D=D),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B, H // hc, L // q_blk),
@@ -522,7 +542,8 @@ def _blocked_backward(q, k, v, mask, seed, g, q_blk, hc, dtype, rate,
             jax.ShapeDtypeStruct((B, L, H * D), jnp.float32),  # dv (f32 acc)
         ],
         interpret=interpret,
-    )(seed, mask[:, None, :], _fold(q), _fold(k), _fold(v), _fold(g))
+    )(_row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v),
+      _fold(g))
     return (
         dq.reshape(B, L, H, D),
         dk.reshape(B, L, H, D).astype(k.dtype),
@@ -600,7 +621,10 @@ def flash_attention(q, k, v, mask, seed=None, dtype=jnp.float32, rate=0.0,
     """Fused attention over [B, L, H, D] with a [B, L] key-validity mask.
 
     ``seed``: int32 array of shape (1,) keying the in-kernel dropout mask
-    (ignored when ``rate == 0``). ``rate``: attention-probs dropout rate —
+    (ignored when ``rate == 0``); internally expanded to a per-batch-row
+    seed vector (``_row_seeds``) so batch-sharded executions hand each
+    data-parallel shard its rows' global mask streams — a [B] vector may
+    also be passed directly. ``rate``: attention-probs dropout rate —
     supported by the fully-fused regime (L <= 512) and by the q-blocked
     regime when BOTH directions have a VMEM-feasible config
     (``supports_blocked_fwd``/``supports_blocked_bwd``); raises ValueError
