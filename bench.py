@@ -159,9 +159,110 @@ def bench_infer(args) -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_converge(args) -> None:
+    """Train on-chip on the synthetic LEARNABLE corpus and emit the loss
+    curve + final eval metrics (VERDICT r2 #1b: proof the framework learns,
+    runnable by the driver on real hardware).
+
+    The corpus (ml_recipe_tpu/data/synthetic.py) makes class and answer span
+    derivable from the question/marker; a working optimizer drives mAP and
+    cls-accuracy far above the 5-class chance floor (0.2) within a few
+    hundred steps — a broken one cannot.
+    """
+    import math
+    import shutil
+    import tempfile
+    from pathlib import Path
+
+    import jax
+
+    from ml_recipe_tpu.data import RawPreprocessor
+    from ml_recipe_tpu.data.synthetic import make_convergence_trainer
+    from ml_recipe_tpu.models import MODEL_PRESETS
+    from ml_recipe_tpu.parallel import build_mesh
+    from ml_recipe_tpu.train import AccuracyCallback, MAPCallback
+
+    mesh = build_mesh()
+    L = args.converge_seq
+    B = args.converge_batch
+
+    tmp = Path(tempfile.mkdtemp(prefix="bench_converge_"))
+    try:
+        # ~90% of the examples form the stratified train split
+        steps_per_epoch = max(int(args.converge_examples * 0.9) // B, 1)
+        n_epochs = max(1, math.ceil(args.converge_steps / steps_per_epoch))
+
+        trainer = make_convergence_trainer(
+            tmp,
+            model_cfg=MODEL_PRESETS[args.model],
+            mesh=mesh,
+            lr=args.converge_lr,
+            n_epochs=n_epochs,
+            batch=B,
+            seq_len=L,
+            n_examples=args.converge_examples,
+            test_size=0.1,
+            n_jobs=args.infer_jobs,
+        )
+
+        # per-step running-average train loss, keyed by global step; the
+        # last record of each epoch is that epoch's mean loss
+        records: dict = {}
+
+        def record(meters, *, step):
+            if "loss" in meters:
+                records[int(step)] = float(meters["loss"]())
+
+        trainer.on_train_metrics = record
+
+        callbacks = [
+            MAPCallback(list(RawPreprocessor.labels2id.keys())),
+            AccuracyCallback(),
+        ]
+        m0 = trainer.test(0, callbacks=callbacks)
+        t0 = time.perf_counter()
+        trainer.train()
+        train_s = time.perf_counter() - t0
+        mT = trainer.test(n_epochs + 1, callbacks=callbacks)
+
+        spe = len(trainer.train_dataloader)
+        loss_curve = [
+            round(records[e * spe - 1], 4)
+            for e in range(1, n_epochs + 1)
+            if (e * spe - 1) in records
+        ]
+        first_step_loss = records.get(0, loss_curve[0] if loss_curve else None)
+
+        final_map = float(mT["map"])
+        print(
+            json.dumps(
+                {
+                    "metric": f"{args.model}_qa_converge_seq{L}_final_map",
+                    "value": round(final_map, 4),
+                    "unit": "map",
+                    # chance floor for 5 balanced classes is 0.2
+                    "vs_baseline": round(final_map / 0.2, 3),
+                    "loss_initial": round(first_step_loss, 4),
+                    "loss_final": loss_curve[-1] if loss_curve else None,
+                    "loss_curve_per_epoch": loss_curve,
+                    "map_initial": round(float(m0["map"]), 4),
+                    "c_acc": round(float(mT["c_acc"]), 4),
+                    "s_acc": round(float(mT["s_acc"]), 4),
+                    "e_acc": round(float(mT["e_acc"]), 4),
+                    "steps": trainer.global_step,
+                    "global_batch": B,
+                    "train_seconds": round(train_s, 1),
+                    "backend": jax.default_backend(),
+                }
+            )
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--mode", choices=("train", "infer"), default="train")
+    parser.add_argument("--mode", choices=("train", "infer", "converge"), default="train")
     parser.add_argument("--seq_len", type=int, default=512)
     parser.add_argument("--global_batch", type=int, default=256)
     # micro-batch 64 (split 4) is the measured single-v5e sweet spot with the
@@ -185,10 +286,18 @@ def main() -> None:
     parser.add_argument("--infer_doc_len", type=int, default=3000)
     parser.add_argument("--infer_jobs", type=int, default=16)
     parser.add_argument("--doc_stride", type=int, default=256)
+    # --mode converge knobs (VERDICT r2 #1b)
+    parser.add_argument("--converge_steps", type=int, default=300)
+    parser.add_argument("--converge_seq", type=int, default=128)
+    parser.add_argument("--converge_batch", type=int, default=64)
+    parser.add_argument("--converge_lr", type=float, default=1e-4)
+    parser.add_argument("--converge_examples", type=int, default=2048)
     args = parser.parse_args()
 
     if args.mode == "infer":
         return bench_infer(args)
+    if args.mode == "converge":
+        return bench_converge(args)
 
     import jax
     import jax.numpy as jnp

@@ -1,0 +1,230 @@
+"""Synthetic LEARNABLE NQ-schema corpus.
+
+The reference demonstrates fine-tuning by training on real Natural Questions
+data to a quality metric with best-checkpoint selection (reference
+README.md:1-51, modules/train.py:104-116, trainer/callback.py:79-108). An
+egress-free environment has no NQ download, so convergence is proven on a
+corpus whose answers are DERIVABLE: a model that learns beats chance by a
+wide margin, a broken optimizer/loss/pipeline cannot.
+
+Construction (one paragraph per document, 5 balanced classes):
+
+- the QUESTION's first word encodes the class label
+  (``is it yes`` -> yes, ``is it no`` -> no, ``find the needle`` -> short,
+  ``describe it all`` -> long, ``nothing is here`` -> unknown);
+- for ``short`` the document contains the marker word ``needle`` exactly
+  once and the short answer is that word — the span heads must learn to
+  point at it;
+- for ``yes``/``no``/``long`` the annotated span is the whole paragraph, so
+  the span heads must point at the document edges (position right after the
+  first [SEP] / the final [SEP]);
+- ``unknown`` lines carry no annotation (the -1,-1 spanless sentinel).
+
+Everything else — filler words, document length, marker position — is
+drawn from a seeded rng, so the mapping question->(class, span) is the ONLY
+signal. Used by ``tests/test_convergence.py`` and ``bench.py --mode
+converge``.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+SPECIALS = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+KEYWORDS = ["yes", "no", "find", "describe", "nothing"]
+MARKER = "needle"
+SUPPORT = ["is", "it", "the", "all", "here", "?", "."]
+FILLERS = [
+    "alpha", "bravo", "carol", "delta", "echo", "fern", "golf", "hotel",
+    "india", "jade", "kilo", "lima", "mike", "norse", "oscar", "papa",
+]
+
+QUESTIONS = {
+    "yes": "is it yes ?",
+    "no": "is it no ?",
+    "short": "find the needle ?",
+    "long": "describe it all ?",
+    "unknown": "nothing is here ?",
+}
+CLASS_CYCLE = ["yes", "no", "short", "long", "unknown"]
+
+
+def write_learnable_vocab(out_dir) -> Path:
+    """WordPiece vocab covering exactly the corpus' closed vocabulary (every
+    word is a single whole-word piece, so word index == token index within
+    the paragraph body)."""
+    out_dir = Path(out_dir)
+    vocab_file = out_dir / "vocab.txt"
+    vocab_file.write_text(
+        "\n".join(SPECIALS + KEYWORDS + [MARKER] + SUPPORT + FILLERS) + "\n"
+    )
+    return vocab_file
+
+
+def make_learnable_line(i: int, rng) -> dict:
+    """One NQ-schema json line of class ``CLASS_CYCLE[i % 5]``."""
+    label = CLASS_CYCLE[i % len(CLASS_CYCLE)]
+
+    n_body = int(rng.integers(8, 24))
+    body = list(rng.choice(FILLERS, size=n_body))
+    if label == "short":
+        pos = int(rng.integers(0, n_body))
+        body[pos] = MARKER
+        # word index within document_text.split(): one leading <P> tag word
+        marker_word = 1 + pos
+        short_answers = [{"start_token": marker_word, "end_token": marker_word + 1}]
+    else:
+        short_answers = []
+
+    words = ["<P>"] + body + ["</P>"]
+    long_span = {"start_token": 0, "end_token": len(words), "candidate_index": 0}
+    annotation = {
+        "yes_no_answer": {"yes": "YES", "no": "NO"}.get(label, "NONE"),
+        "long_answer": (
+            {"start_token": -1, "end_token": -1, "candidate_index": -1}
+            if label == "unknown"
+            else long_span
+        ),
+        "short_answers": short_answers,
+    }
+    return {
+        "example_id": str(i),
+        "document_text": " ".join(words),
+        "question_text": QUESTIONS[label],
+        "annotations": [annotation],
+        "long_answer_candidates": [
+            {"start_token": 0, "end_token": len(words), "top_level": True}
+        ],
+    }
+
+
+def write_learnable_corpus(out_path, *, n_examples: int = 200, seed: int = 0) -> Path:
+    import numpy as np
+
+    out_path = Path(out_path)
+    rng = np.random.default_rng(seed)
+    with open(out_path, "w") as fh:
+        for i in range(n_examples):
+            fh.write(json.dumps(make_learnable_line(i, rng)) + "\n")
+    return out_path
+
+
+class ConvergenceTP:
+    """Trainer hyperparameters for the convergence harness."""
+
+    loss = "ce"
+    smooth_alpha = 0.01
+    focal_alpha = 1
+    focal_gamma = 2
+    w_start = 1
+    w_end = 1
+    w_start_reg = 0.5
+    w_end_reg = 0.5
+    w_cls = 1
+    weight_decay = 0.01
+    warmup_coef = 0.05
+    optimizer = "adam"
+    finetune = False
+    best_metric = "map"
+    best_order = ">"
+
+    def __init__(self, lr: float):
+        self.lr = lr
+
+
+def make_convergence_trainer(
+    workdir,
+    *,
+    model_cfg,
+    mesh,
+    lr: float,
+    n_epochs: int,
+    batch: int,
+    seq_len: int = 64,
+    n_examples: int = 200,
+    test_size: float = 0.2,
+    n_jobs: int = 2,
+    seed: int = 0,
+):
+    """Corpus -> preprocess -> datasets -> Trainer, the ONE pipeline both
+    ``tests/test_convergence.py`` and ``bench.py --mode converge`` train on
+    (shared so the CI proof and the on-hardware artifact cannot drift).
+
+    ``workdir`` must exist; returns a ready Trainer whose train/test sets
+    cover all five classes (stratified split).
+    """
+    import numpy as np
+
+    from ..data import RawPreprocessor, SplitDataset
+    from ..data.collate import make_collate_fun
+    from ..losses import build_loss
+    from ..models import QAModel
+    from ..tokenizer import Tokenizer
+    from ..train import Trainer
+
+    workdir = Path(workdir)
+    vocab = write_learnable_vocab(workdir)
+    corpus = write_learnable_corpus(
+        workdir / "corpus.jsonl", n_examples=n_examples, seed=seed
+    )
+    tokenizer = Tokenizer("bert", str(vocab), lowercase=True)
+    pre = RawPreprocessor(corpus, workdir / "proc", test_size=test_size)
+    _, _, (train_idx, _, test_idx, _) = pre()
+
+    common = dict(
+        tokenizer=tokenizer,
+        max_seq_len=seq_len,
+        max_question_len=8,
+        doc_stride=max(16, seq_len - 16),
+        split_by_sentence=False,
+        truncate=False,
+        rng=np.random.default_rng(seed),
+    )
+    train_ds = SplitDataset(workdir / "proc", indexes=train_idx, **common)
+    test_ds = SplitDataset(workdir / "proc", indexes=test_idx, test=True, **common)
+
+    tp = ConvergenceTP(lr)
+    import dataclasses
+
+    import jax
+
+    # fit the config to the harness: the closed vocab is tiny (no point in
+    # a 30k embedding) and positions must cover seq_len
+    model_cfg = dataclasses.replace(
+        model_cfg,
+        vocab_size=max(len(tokenizer), 128),
+        max_position_embeddings=max(
+            model_cfg.max_position_embeddings, seq_len + 2
+        ),
+    )
+    model = QAModel(model_cfg)
+    params = model.init(
+        jax.random.key(seed), np.zeros((1, 8), dtype=np.int32)
+    )["params"]
+
+    trainer = Trainer(
+        model=model,
+        params=params,
+        loss=build_loss(tp),
+        collate_fun=make_collate_fun(tokenizer, max_seq_len=seq_len),
+        trainer_params=tp,
+        train_dataset=train_ds,
+        test_dataset=test_ds,
+        mesh=mesh,
+        n_epochs=n_epochs,
+        train_batch_size=batch,
+        test_batch_size=batch,
+        batch_split=1,
+        n_jobs=n_jobs,
+        warmup_coef=tp.warmup_coef,
+        max_grad_norm=1.0,
+        seed=seed,
+    )
+    if len(trainer.train_dataloader) == 0:
+        raise ValueError(
+            f"convergence harness has zero train batches: "
+            f"{len(train_idx)} train examples with drop_last at batch "
+            f"{batch} — lower the batch size or raise n_examples."
+        )
+    return trainer

@@ -125,6 +125,12 @@ class Trainer:
     # auto-detect either layout.
     sharded_checkpoint: bool = False
 
+    # Optional metrics tap: called as ``on_train_metrics(meters, step=N)``
+    # after every consumed train step with the epoch's running AverageMeters
+    # (the supported way to capture a loss curve — bench --mode converge and
+    # the convergence test use it; the TB writer is unaffected).
+    on_train_metrics: Any = None
+
     def __post_init__(self):
         if self.mesh is None:
             self.mesh = build_mesh()
@@ -572,7 +578,7 @@ class Trainer:
 
     def train(self, after_epoch_funcs=None):
         if self.train_dataloader is None:
-            logger.warning("You have not specified train dataset, so you cannot run train method.")
+            logger.warning("No train dataset was provided; train() is a no-op.")
             return
 
         after_epoch_funcs = after_epoch_funcs or []
@@ -615,6 +621,8 @@ class Trainer:
                 else:
                     avg_meters[k].update(float(v))
             self._update_writer(avg_meters, prefix="train", step=step_no)
+            if self.on_train_metrics is not None:
+                self.on_train_metrics(avg_meters, step=step_no)
             if tqdm_data is not None:
                 tqdm_data.set_postfix_str(_console_str(avg_meters))
 
@@ -664,7 +672,7 @@ class Trainer:
 
     def test(self, epoch_i, *, callbacks=None):
         if self.test_dataloader is None:
-            logger.warning("You have not specified test dataset, so you cannot run test method.")
+            logger.warning("No test dataset was provided; test() is a no-op.")
             return None
 
         if callbacks is not None and not isinstance(callbacks, (list, tuple)):

@@ -268,3 +268,35 @@ def test_bench_infer_mode_smoke():
     assert rec["value"] > 0
     assert rec["docs"] == 6
     assert rec["chunks"] >= rec["docs"]  # long docs expand to >= 1 chunk each
+
+
+def test_bench_converge_mode_smoke():
+    """bench.py --mode converge (VERDICT r2 #1b): the driver-runnable
+    learns-or-not artifact must emit the JSON contract line with a falling
+    loss curve even at smoke scale."""
+    import json
+    import os
+    import subprocess
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    out = subprocess.run(
+        [
+            sys.executable, str(repo / "bench.py"), "--mode", "converge",
+            "--model", "bert-tiny", "--converge_steps", "40",
+            "--converge_seq", "64", "--converge_batch", "16",
+            "--converge_examples", "200", "--converge_lr", "2e-3",
+            "--infer_jobs", "2",
+        ],
+        cwd=str(repo),
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+    rec = json.loads(line)
+    assert rec["unit"] == "map"
+    assert rec["value"] > 0
+    assert rec["loss_final"] < rec["loss_initial"]
+    assert len(rec["loss_curve_per_epoch"]) >= 1
+    assert rec["steps"] >= 40

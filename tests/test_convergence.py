@@ -1,0 +1,119 @@
+"""Convergence proof: the framework LEARNS.
+
+The reference's whole purpose is fine-tuning to a quality metric with
+best-checkpoint selection (reference README.md:1-51, modules/train.py:104-116,
+trainer/callback.py:79-108). Equivalence/shape tests can pass with a broken
+optimizer sign; this module cannot: it trains bert-tiny on the synthetic
+LEARNABLE corpus (ml_recipe_tpu/data/synthetic.py — class and answer span are
+derivable from the question/marker) through the REAL pipeline (RawPreprocessor
+-> SplitDataset -> collate -> Trainer's jitted SPMD step) and asserts
+
+- final train loss < 0.5x initial train loss,
+- eval cls-accuracy and mAP beat the 5-class chance floor by a wide margin,
+- span (start/end) accuracy beats its ~1/64 chance floor by a wide margin,
+- ``best.ch`` tracks the improvement (written at a later step than the
+  chance-level epoch-0 eval, with a better metric).
+
+The harness (corpus -> preprocess -> datasets -> Trainer) is SHARED with
+``bench.py --mode converge`` via ``make_convergence_trainer``, so the CI
+proof and the on-hardware driver artifact exercise the same pipeline.
+"""
+
+import numpy as np
+import pytest
+
+from ml_recipe_tpu.data import RawPreprocessor
+from ml_recipe_tpu.data.synthetic import make_convergence_trainer
+from ml_recipe_tpu.models import EncoderConfig
+from ml_recipe_tpu.parallel import build_mesh
+from ml_recipe_tpu.train import (
+    AccuracyCallback,
+    MAPCallback,
+    SaveBestCallback,
+)
+
+pytestmark = pytest.mark.slow
+
+
+def test_training_learns_and_best_checkpoint_tracks_it(tmp_path):
+    trainer = make_convergence_trainer(
+        tmp_path,
+        model_cfg=EncoderConfig(
+            hidden_size=64,
+            num_layers=2,
+            num_heads=2,
+            intermediate_size=128,
+            max_position_embeddings=66,
+            num_labels=5,
+        ),
+        mesh=build_mesh("data:8"),
+        lr=2e-3,
+        n_epochs=12,
+        batch=16,
+        n_examples=200,
+    )
+    assert len(trainer.test_dataset) >= 25  # stratified: every class in eval
+
+    # record the within-epoch running-average train loss after every step
+    # (on_train_metrics is the Trainer's supported metrics tap)
+    train_curve = []
+
+    def record(meters, *, step):
+        if "loss" in meters:
+            train_curve.append(float(meters["loss"]()))
+
+    trainer.on_train_metrics = record
+
+    class SBParams:
+        best_metric = "map"
+        best_order = ">"
+        dump_dir = tmp_path
+        experiment_name = "conv"
+
+    save_best = SaveBestCallback(SBParams())
+    callbacks = [
+        MAPCallback(list(RawPreprocessor.labels2id.keys())),
+        AccuracyCallback(),
+        save_best,
+    ]
+
+    # chance-level eval BEFORE training: writes best.ch at global_step 0, so
+    # "best.ch tracks improvement" below is a real claim, not an artifact of
+    # SaveBestCallback firing once
+    m0 = trainer.test(0, callbacks=callbacks)
+    assert m0 is not None and "map" in m0
+    best_ckpt = tmp_path / "conv" / "best.ch"
+    assert best_ckpt.exists()
+    map0, value0 = m0["map"], save_best.value
+
+    trainer.train(
+        after_epoch_funcs=[
+            lambda epoch_i: trainer.test(epoch_i, callbacks=callbacks)
+        ]
+    )
+    mT = trainer.test(trainer.n_epochs + 1, callbacks=callbacks)
+
+    # --- the loss went down ---
+    assert len(train_curve) >= 50
+    initial, final = train_curve[0], train_curve[-1]
+    assert final < 0.5 * initial, (
+        f"train loss did not halve: {initial:.4f} -> {final:.4f}"
+    )
+
+    # --- eval metrics beat chance by a wide margin ---
+    # 5 balanced classes: accuracy chance floor 0.2, AP chance floor ~0.2
+    assert mT["c_acc"] > 0.8, f"cls accuracy {mT['c_acc']:.3f} ~ chance"
+    assert mT["map"] > 0.8, f"mAP {mT['map']:.3f} ~ chance (0.2)"
+    assert mT["map"] > map0 + 0.3, f"mAP did not improve: {map0:.3f} -> {mT['map']:.3f}"
+    # span heads: chance floor ~1/64
+    assert mT["s_acc"] > 0.5, f"start accuracy {mT['s_acc']:.3f} ~ chance"
+    assert mT["e_acc"] > 0.5, f"end accuracy {mT['e_acc']:.3f} ~ chance"
+    # eval loss fell too
+    assert mT["loss"] < 0.5 * m0["loss"]
+
+    # --- best.ch tracked the improvement ---
+    from flax import serialization
+
+    state = serialization.msgpack_restore(best_ckpt.read_bytes())
+    assert int(state["global_step"]) > 0, "best.ch still holds the epoch-0 eval"
+    assert save_best.value > value0 + 0.3
