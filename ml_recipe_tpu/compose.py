@@ -67,7 +67,7 @@ def init_tokenizer(model_params, *, bpe_dropout: Optional[float] = None):
             dropout=bpe_dropout,
         )
 
-    logger.warning("Specify vocab file to use faster tokenizer implementation.")
+    logger.warning("No vocab file given; falling back to the slower tokenizer path.")
     try:
         if model_name == "bert":
             from transformers import BertTokenizer

@@ -44,8 +44,7 @@ def initialize_distributed(
 
     address = _strip_scheme(coordinator_address or "127.0.0.1:9080")
     logger.warning(
-        "It can take a while to start all worker processes and connect "
-        "to the coordinator."
+        "Waiting for every worker to reach the coordinator; startup may be slow."
     )
     jax.distributed.initialize(
         coordinator_address=address,

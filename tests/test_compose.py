@@ -14,6 +14,9 @@ from ml_recipe_tpu.config.parser import get_model_parser, get_params, get_traine
 
 from helpers import make_tokenizer, nq_line, write_corpus, write_vocab
 
+# no-jit / tiny-jit module: part of the <2 min unit tier (VERDICT r2 #7)
+pytestmark = pytest.mark.unit
+
 
 def _model_params(tmp_path, **over):
     parser = get_model_parser()

@@ -15,6 +15,9 @@ from ml_recipe_tpu.config import (
 )
 from ml_recipe_tpu.config.parser import parse_mesh_spec, resolve_precision
 
+# no-jit / tiny-jit module: part of the <2 min unit tier (VERDICT r2 #7)
+pytestmark = pytest.mark.unit
+
 
 def test_cast2_none_string():
     assert cast2(int)("None") is None

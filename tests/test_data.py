@@ -27,6 +27,9 @@ from ml_recipe_tpu.data.sentence import split_sentences
 
 from helpers import make_tokenizer, nq_line, write_corpus
 
+# no-jit / tiny-jit module: part of the <2 min unit tier (VERDICT r2 #7)
+pytestmark = pytest.mark.unit
+
 
 # -- preprocessor -------------------------------------------------------------
 

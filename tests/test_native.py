@@ -14,6 +14,9 @@ REPO = Path(__file__).resolve().parent.parent
 
 from helpers import BASE_VOCAB, WORDS, write_vocab
 
+# no-jit / tiny-jit module: part of the <2 min unit tier (VERDICT r2 #7)
+pytestmark = pytest.mark.unit
+
 
 @pytest.fixture(scope="session", autouse=True)
 def build_native():

@@ -22,6 +22,9 @@ from ml_recipe_tpu.data.chunking import encode_document
 from ml_recipe_tpu.data.datasets import ChunkDataset, SplitDataset
 from ml_recipe_tpu.tokenizer import Tokenizer
 
+# no-jit / tiny-jit module: part of the <2 min unit tier (VERDICT r2 #7)
+pytestmark = pytest.mark.unit
+
 FIXTURE = Path(__file__).parent / "fixtures" / "nq_real_schema.jsonl"
 
 _TAG = lambda w: w.startswith("<")  # noqa: E731

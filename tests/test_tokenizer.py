@@ -6,6 +6,9 @@ from ml_recipe_tpu.tokenizer import ByteLevelBPETokenizer, Tokenizer, WordPieceT
 
 from helpers import write_vocab
 
+# no-jit / tiny-jit module: part of the <2 min unit tier (VERDICT r2 #7)
+pytestmark = pytest.mark.unit
+
 
 def test_wordpiece_basic(tmp_path):
     tok = WordPieceTokenizer(str(write_vocab(tmp_path)), lowercase=True)

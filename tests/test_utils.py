@@ -1,9 +1,13 @@
+import pytest
 import logging
 
 import numpy as np
 
 from ml_recipe_tpu.utils import RngPool, get_logger, set_seed, time_profiler
 from ml_recipe_tpu.utils.profiler import StepTimer
+
+# no-jit / tiny-jit module: part of the <2 min unit tier (VERDICT r2 #7)
+pytestmark = pytest.mark.unit
 
 
 def test_get_logger_resets_handlers(tmp_path):
