@@ -123,14 +123,14 @@ class ConvergenceTP:
     w_end_reg = 0.5
     w_cls = 1
     weight_decay = 0.01
-    warmup_coef = 0.05
     optimizer = "adam"
     finetune = False
     best_metric = "map"
     best_order = ">"
 
-    def __init__(self, lr: float):
+    def __init__(self, lr: float, warmup_coef: float = 0.05):
         self.lr = lr
+        self.warmup_coef = warmup_coef
 
 
 def make_convergence_trainer(
@@ -146,6 +146,7 @@ def make_convergence_trainer(
     test_size: float = 0.2,
     n_jobs: int = 2,
     seed: int = 0,
+    warmup_coef: float = 0.05,
 ):
     """Corpus -> preprocess -> datasets -> Trainer, the ONE pipeline both
     ``tests/test_convergence.py`` and ``bench.py --mode converge`` train on
@@ -184,7 +185,7 @@ def make_convergence_trainer(
     train_ds = SplitDataset(workdir / "proc", indexes=train_idx, **common)
     test_ds = SplitDataset(workdir / "proc", indexes=test_idx, test=True, **common)
 
-    tp = ConvergenceTP(lr)
+    tp = ConvergenceTP(lr, warmup_coef=warmup_coef)
     import dataclasses
 
     import jax

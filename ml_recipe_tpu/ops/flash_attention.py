@@ -103,7 +103,7 @@ def _softmax_probs(q, k, mask, scale):
 
 
 def _fused_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, o_ref,
-                      *, scale: float, rate: float, hc: int,
+                      *lse_ref, scale: float, rate: float, hc: int,
                       D: int):
     """One (batch, head-group) program: softmax(q k^T / sqrt(d)) v for ``hc``
     heads, with optional attention-probs dropout, fully in VMEM. Operands
@@ -113,7 +113,13 @@ def _fused_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, o_ref,
     kernel layout cost 4 HBM round-trips of q/k/v/o per layer — measured
     10% of the bert-base train step). Heads are static lane slices of the
     folded block, looped unrolled; ``hc`` bounds the block so in/out
-    double-buffers + [L, L] f32 temporaries fit VMEM."""
+    double-buffers + [L, L] f32 temporaries fit VMEM.
+
+    When a trailing ``lse_ref`` output ([1, hc, L, 1] f32 — sublane-oriented
+    so no vector transpose is needed on either side) is present, each row's
+    logsumexp is also written — the backward kernels then recompute
+    probabilities as ``exp(s - lse)`` without redoing the max/sum/divide
+    normalization sweeps."""
     b, hj = pl.program_id(0), pl.program_id(1)
     mask = mask_ref[0, 0, :]
     for h in range(hc):
@@ -122,26 +128,47 @@ def _fused_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, o_ref,
         k = k_ref[0, :, sl]
         v = v_ref[0, :, sl]
 
-        p = _softmax_probs(q, k, mask, scale)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        s = jnp.where(mask[None, :] > 0, s, _NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        e = jnp.exp(s - m)
+        l = jnp.sum(e, axis=-1, keepdims=True)
+        if lse_ref:
+            lse_ref[0][0, h, :, :] = m + jnp.log(l)  # [L, 1]
 
         if rate > 0.0:
             u = _uniform_grid(seed_ref[b], hj * hc + h, q.shape[0])
-            p = jnp.where(u >= rate, p * (1.0 / (1.0 - rate)), 0.0)
+            e = jnp.where(u >= rate, e * (1.0 / (1.0 - rate)), 0.0)
 
+        # the softmax divide folds into a per-row scale of the [L, D]
+        # output instead of a full [L, L] VPU pass over the probabilities
         o = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            e.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )
+        ) * (1.0 / l)
         o_ref[0, :, sl] = o.astype(o_ref.dtype)
 
 
-def _attention_bwd_math(q, k, v, g, mask, scale, *, drop=None):
+def _attention_bwd_math(q, k, v, g, mask, scale, *, drop=None, lse=None):
     """Exact softmax-attention backward for one head, probabilities
     recomputed in VMEM. ``q``/``g`` may be a q-block; ``k``/``v`` are the
     full rows. ``drop``: optional ``(keep_bool_grid, inv_rate)`` applying
-    the forward's dropout in-kernel. Returns ``(dq, dk, dv)`` in f32,
-    where dk/dv have k's row count."""
-    p = _softmax_probs(q, k, mask, scale)  # [q_rows, L] f32, pre-dropout
+    the forward's dropout in-kernel. ``lse``: optional [q_rows, 1] per-row
+    logsumexp saved by the forward — probabilities then come from ONE
+    ``exp(s - lse)`` instead of the max/sum/divide normalization sweeps.
+    Returns ``(dq, dk, dv)`` in f32, where dk/dv have k's row count."""
+    if lse is not None:
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        s = jnp.where(mask[None, :] > 0, s, _NEG_INF)
+        p = jnp.exp(s - lse)  # [q_rows, L] f32, pre-dropout
+    else:
+        p = _softmax_probs(q, k, mask, scale)
     if drop is not None:
         keep, inv = drop
         p_drop = jnp.where(keep, p * inv, 0.0)
@@ -177,12 +204,13 @@ def _attention_bwd_math(q, k, v, g, mask, scale, *, drop=None):
 
 
 def _fused_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
-                      dq_ref, dk_ref, dv_ref,
+                      lse_ref, dq_ref, dk_ref, dv_ref,
                       *, scale: float, rate: float, hc: int,
                       D: int):
     """One (batch, head-group) program: exact attention backward for ``hc``
-    heads, recomputing the probabilities (and regenerating the identical
-    dropout mask) in VMEM. Folded [B, L, H*D] layout like the forward."""
+    heads, recomputing the probabilities from the forward's saved per-row
+    logsumexp (and regenerating the identical dropout mask) in VMEM.
+    Folded [B, L, H*D] layout like the forward."""
     b, hj = pl.program_id(0), pl.program_id(1)
     mask = mask_ref[0, 0, :]
     for h in range(hc):
@@ -199,7 +227,9 @@ def _fused_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
             ) >= rate
             drop = (keep, jnp.float32(1.0 / (1.0 - rate)))
 
-        dq, dk, dv = _attention_bwd_math(q, k, v, g, mask, scale, drop=drop)
+        dq, dk, dv = _attention_bwd_math(
+            q, k, v, g, mask, scale, drop=drop, lse=lse_ref[0, h, :, :]
+        )
 
         dq_ref[0, :, sl] = dq.astype(dq_ref.dtype)
         dk_ref[0, :, sl] = dk.astype(dk_ref.dtype)
@@ -207,13 +237,13 @@ def _fused_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
 
 
 def _blocked_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
-                        dq_ref, dk_ref, dv_ref,
+                        lse_ref, dq_ref, dk_ref, dv_ref,
                         *, scale: float, rate: float, hc: int,
                         D: int):
     """Fused long-sequence backward: one (batch, head-group, q-block)
-    program. The whole K/V for the head group stays resident in VMEM, so
-    each program computes the EXACT full-row softmax for its q rows (no
-    saved lse/max residuals needed) and the full [q_blk, L] score gradient.
+    program. The whole K/V for the head group stays resident in VMEM; each
+    program recomputes its q rows' EXACT probabilities from the forward's
+    saved per-row logsumexp and the full [q_blk, L] score gradient.
     dq writes its own q-block; dk/dv accumulate in f32 into output blocks
     whose index map is constant in the q-block dimension — Pallas keeps
     them resident across the q sweep and writes back once per (b, hj).
@@ -240,7 +270,7 @@ def _blocked_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
             k_ref[0, :, sl],   # [L, D] (whole)
             v_ref[0, :, sl],   # [L, D] (whole)
             g_ref[0, :, sl],   # [q_blk, D]
-            mask, scale, drop=drop,
+            mask, scale, drop=drop, lse=lse_ref[0, h, :, :],
         )
 
         dq_ref[0, :, sl] = dq.astype(dq_ref.dtype)
@@ -257,11 +287,13 @@ def _blocked_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
 
 
 def _blocked_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, o_ref,
-                        *, scale: float, rate: float, hc: int,
+                        *lse_ref, scale: float, rate: float, hc: int,
                         D: int):
     """One (batch, head-group, q-block) program for longer sequences, with
     optional in-kernel attention-probs dropout (keep-bits keyed by the
-    absolute row index so the backward regenerates the same mask)."""
+    absolute row index so the backward regenerates the same mask). A
+    trailing ``lse_ref`` output ([1, hc, q_blk, 1] f32) saves each row's
+    logsumexp for the backward, like the fused kernel's."""
     b, hj, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     mask = mask_ref[0, 0, :]
     L = k_ref.shape[1]
@@ -271,17 +303,28 @@ def _blocked_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0, :, sl]
         k = k_ref[0, :, sl]
         v = v_ref[0, :, sl]
-        p = _softmax_probs(q, k, mask, scale)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        s = jnp.where(mask[None, :] > 0, s, _NEG_INF)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        e = jnp.exp(s - m)
+        l = jnp.sum(e, axis=-1, keepdims=True)
+        if lse_ref:
+            lse_ref[0][0, h, :, :] = m + jnp.log(l)  # [q_blk, 1]
         if rate > 0.0:
             u = _uniform_grid(
                 seed_ref[b], hj * hc + h, L,
                 rows=q_blk, row_offset=qi * q_blk,
             )
-            p = jnp.where(u >= rate, p * (1.0 / (1.0 - rate)), 0.0)
+            e = jnp.where(u >= rate, e * (1.0 / (1.0 - rate)), 0.0)
+        # softmax divide folded into a per-row scale of the [q_blk, D]
+        # output instead of a [q_blk, L] VPU pass
         o = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            e.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )
+        ) * (1.0 / l)
         o_ref[0, :, sl] = o.astype(o_ref.dtype)
 
 
@@ -353,17 +396,36 @@ def _pick_head_chunk(H: int, D: int, bytes_per_head: int,
     return min(legal)
 
 
-def _flash_forward(q, k, v, mask, seed, dtype, rate, interpret: bool):
+def _flash_forward(q, k, v, mask, seed, dtype, rate, interpret: bool,
+                   want_lse: bool = False):
     B, L, H, D = q.shape
     hc = _pick_head_chunk(
         H, D,
         bytes_per_head=2 * L * D * (3 * q.dtype.itemsize
-                                    + jnp.dtype(dtype).itemsize),
+                                    + jnp.dtype(dtype).itemsize)
+        # the sublane-oriented [hc*L, 1] lse block lane-pads to full
+        # (8, 128) tiles: L*128*4 bytes per head, double-buffered
+        # (without this the bert-base shape picks hc=12 and lands over
+        # the 16 MB scoped-vmem limit)
+        + (2 * L * 128 * 4 if want_lse else 0),
         temp_bytes=3 * L * L * 4,  # scores/probs/dropout-uniform f32
     )
     spec_lf = pl.BlockSpec((1, L, hc * D), lambda b, hj, *_: (b, 0, hj))
 
-    out = pl.pallas_call(
+    out_specs = [spec_lf]
+    out_shape = [jax.ShapeDtypeStruct((B, L, H * D), dtype)]
+    if want_lse:
+        # [B, H, L, 1] sublane-oriented layout: rows stay sublanes in both
+        # the producing and consuming kernels (no vector transposes), and
+        # the trailing (L, 1) block dims are Mosaic-legal (8 | L, trailing
+        # 1 spans the array); the same layout serves the q-blocked kernels
+        # with (q_blk, 1) row slices
+        out_specs.append(
+            pl.BlockSpec((1, hc, L, 1), lambda b, hj, *_: (b, hj, 0, 0))
+        )
+        out_shape.append(jax.ShapeDtypeStruct((B, H, L, 1), jnp.float32))
+
+    res = pl.pallas_call(
         functools.partial(_fused_fwd_kernel, scale=1.0 / (D ** 0.5),
                           rate=rate, hc=hc, D=D),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -373,16 +435,27 @@ def _flash_forward(q, k, v, mask, seed, dtype, rate, interpret: bool):
                 pl.BlockSpec((1, 1, L), lambda b, hj, *_: (b, 0, 0)),  # mask
                 spec_lf, spec_lf, spec_lf,                             # q k v
             ],
-            out_specs=spec_lf,
+            out_specs=out_specs,
         ),
-        out_shape=jax.ShapeDtypeStruct((B, L, H * D), dtype),
+        out_shape=out_shape,
         interpret=interpret,
     )(_row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v))
-    return out.reshape(B, L, H, D)
+    if want_lse:
+        return res[0].reshape(B, L, H, D), res[1]
+    return res[0].reshape(B, L, H, D)
 
 
-def _flash_backward(q, k, v, mask, seed, g, dtype, rate, interpret: bool):
+def _flash_backward(q, k, v, mask, seed, g, lse, dtype, rate,
+                    interpret: bool):
     B, L, H, D = q.shape
+    # The lane-padded lse input block (2*L*128*4 per head) is deliberately
+    # NOT counted here, unlike the forward/blocked cfgs: the formula already
+    # sits at 11.8/12 MB at the shipped bert-base geometry, so counting it
+    # flips hc 6 -> 2 — yet hc=6 with the lse block measurably FITS the real
+    # 16 MB scoped-vmem limit (every round-3 full-bench run) because the
+    # 12 MB paper budget carries ~4 MB of real headroom. A larger backward
+    # geometry that genuinely overflows fails loudly at compile; revisit
+    # this accounting then.
     hc = _pick_head_chunk(
         H, D,
         bytes_per_head=2 * L * D * 7 * q.dtype.itemsize,  # q k v g dq dk dv
@@ -399,13 +472,14 @@ def _flash_backward(q, k, v, mask, seed, g, dtype, rate, interpret: bool):
             in_specs=[
                 pl.BlockSpec((1, 1, L), lambda b, hj, *_: (b, 0, 0)),  # mask
                 spec_lf, spec_lf, spec_lf, spec_lf,                    # q k v g
+                pl.BlockSpec((1, hc, L, 1), lambda b, hj, *_: (b, hj, 0, 0)),  # lse
             ],
             out_specs=[spec_lf, spec_lf, spec_lf],
         ),
         out_shape=[jax.ShapeDtypeStruct((B, L, H * D), q.dtype)] * 3,
         interpret=interpret,
     )(_row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v),
-      _fold(g))
+      _fold(g), lse)
     return tuple(x.reshape(B, L, H, D) for x in (dq, dk, dv))
 
 
@@ -431,6 +505,11 @@ def _blocked_fwd_cfg(L: int, H: int, D: int, in_itemsize: int,
         block_bytes = hc * D * 2 * (
             (2 * L + q_blk) * in_itemsize + q_blk * out_itemsize
         )
+        # the [1, hc, q_blk, 1] lse output block (training forwards save
+        # per-row logsumexp for the backward) lane-pads to (8, 128) tiles:
+        # q_blk*128*4 bytes per head, double-buffered. Counted always so
+        # the feasibility gates cover the training path.
+        block_bytes += hc * 2 * q_blk * 128 * 4
         if block_bytes + temp_bytes <= _VMEM_BUDGET:
             return q_blk, hc
     return None
@@ -449,13 +528,24 @@ def supports_blocked_fwd(L: int, H: int, D: int, in_itemsize: int,
 
 
 def _blocked_forward(q, k, v, mask, seed, q_blk, hc, dtype, rate,
-                     interpret: bool):
+                     interpret: bool, want_lse: bool = False):
     B, L, H, D = q.shape
+
+    out_specs = [
+        pl.BlockSpec((1, q_blk, hc * D), lambda b, hj, qi, *_: (b, qi, hj))
+    ]
+    out_shape = [jax.ShapeDtypeStruct((B, L, H * D), dtype)]
+    if want_lse:
+        out_specs.append(
+            pl.BlockSpec((1, hc, q_blk, 1),
+                         lambda b, hj, qi, *_: (b, hj, qi, 0))
+        )
+        out_shape.append(jax.ShapeDtypeStruct((B, H, L, 1), jnp.float32))
 
     # q-blocks INNERMOST: the k/v index map is constant in qi, so Pallas
     # keeps each head-group's full K/V resident across all q-blocks instead
     # of re-streaming them L/q_blk times from HBM.
-    out = pl.pallas_call(
+    res = pl.pallas_call(
         functools.partial(_blocked_fwd_kernel, scale=1.0 / (D ** 0.5),
                           rate=rate, hc=hc, D=D),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -467,14 +557,14 @@ def _blocked_forward(q, k, v, mask, seed, q_blk, hc, dtype, rate,
                 pl.BlockSpec((1, L, hc * D), lambda b, hj, qi, *_: (b, 0, hj)),       # k
                 pl.BlockSpec((1, L, hc * D), lambda b, hj, qi, *_: (b, 0, hj)),       # v
             ],
-            out_specs=pl.BlockSpec(
-                (1, q_blk, hc * D), lambda b, hj, qi, *_: (b, qi, hj)
-            ),
+            out_specs=out_specs,
         ),
-        out_shape=jax.ShapeDtypeStruct((B, L, H * D), dtype),
+        out_shape=out_shape,
         interpret=interpret,
     )(_row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v))
-    return out.reshape(B, L, H, D)
+    if want_lse:
+        return res[0].reshape(B, L, H, D), res[1]
+    return res[0].reshape(B, L, H, D)
 
 
 def _blocked_bwd_cfg(L: int, H: int, D: int, in_itemsize: int,
@@ -498,6 +588,8 @@ def _blocked_bwd_cfg(L: int, H: int, D: int, in_itemsize: int,
         block_bytes = hc * D * (
             2 * (2 * L + 3 * q_blk) * in_itemsize + 2 * L * 4
         )
+        # lane-padded [1, hc, q_blk, 1] lse input block (see fwd cfg)
+        block_bytes += hc * 2 * q_blk * 128 * 4
         if block_bytes + temp_bytes <= _VMEM_BUDGET:
             return q_blk, hc
     return None
@@ -515,7 +607,7 @@ def supports_blocked_bwd(L: int, H: int, D: int, in_itemsize: int,
     )
 
 
-def _blocked_backward(q, k, v, mask, seed, g, q_blk, hc, dtype, rate,
+def _blocked_backward(q, k, v, mask, seed, g, lse, q_blk, hc, dtype, rate,
                       interpret: bool):
     B, L, H, D = q.shape
 
@@ -533,6 +625,8 @@ def _blocked_backward(q, k, v, mask, seed, g, q_blk, hc, dtype, rate,
                 spec_q,                                                # q block
                 spec_l, spec_l,                                        # k v whole
                 spec_q,                                                # g block
+                pl.BlockSpec((1, hc, q_blk, 1),
+                             lambda b, hj, qi, *_: (b, hj, qi, 0)),    # lse
             ],
             out_specs=[spec_q, spec_l, spec_l],
         ),
@@ -543,7 +637,7 @@ def _blocked_backward(q, k, v, mask, seed, g, q_blk, hc, dtype, rate,
         ],
         interpret=interpret,
     )(_row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v),
-      _fold(g))
+      _fold(g), lse)
     return (
         dq.reshape(B, L, H, D),
         dk.reshape(B, L, H, D).astype(k.dtype),
@@ -577,25 +671,44 @@ def _flash_core(q, k, v, mask, seed, dtype, rate, interpret):
 
 
 def _fwd(q, k, v, mask, seed, dtype, rate, interpret):
+    B, L, H, D = q.shape
+    if supports_fused_bwd(L):
+        # the forward also emits per-row logsumexp so the backward skips
+        # the max/sum/divide normalization sweeps
+        out, lse = _flash_forward(
+            q, k, v, mask, seed, dtype, rate, interpret, want_lse=True
+        )
+        return out, (q, k, v, mask, seed, lse)
+    if supports_blocked_bwd(L, H, D, q.dtype.itemsize, rate):
+        cfg = _blocked_fwd_cfg(
+            L, H, D, q.dtype.itemsize, jnp.dtype(dtype).itemsize, rate
+        )
+        if cfg is not None:
+            out, lse = _blocked_forward(
+                q, k, v, mask, seed, *cfg, dtype, rate, interpret,
+                want_lse=True,
+            )
+            return out, (q, k, v, mask, seed, lse)
     out = _flash_core(q, k, v, mask, seed, dtype, rate, interpret)
-    return out, (q, k, v, mask, seed)
+    return out, (q, k, v, mask, seed, None)
 
 
 def _bwd(dtype, rate, interpret, residuals, g):
-    q, k, v, mask, seed = residuals
+    q, k, v, mask, seed, lse = residuals
     L = q.shape[1]
     if supports_fused_bwd(L):
         dq, dk, dv = _flash_backward(
-            q, k, v, mask, seed, g.astype(q.dtype), dtype, rate, interpret
+            q, k, v, mask, seed, g.astype(q.dtype), lse, dtype, rate,
+            interpret,
         )
         return dq, dk, dv, None, None
-    if L > _FUSED_BWD_MAX_LEN:
+    if L > _FUSED_BWD_MAX_LEN and lse is not None:
         H, D = q.shape[2], q.shape[3]
         cfg = _blocked_bwd_cfg(L, H, D, q.dtype.itemsize, rate)
         if cfg is not None:
             dq, dk, dv = _blocked_backward(
-                q, k, v, mask, seed, g.astype(q.dtype), *cfg, dtype, rate,
-                interpret,
+                q, k, v, mask, seed, g.astype(q.dtype), lse, *cfg, dtype,
+                rate, interpret,
             )
             return dq, dk, dv, None, None
     if rate > 0.0:

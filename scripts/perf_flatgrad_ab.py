@@ -16,6 +16,11 @@ Run on the TPU chip:  python scripts/perf_flatgrad_ab.py
 
 from __future__ import annotations
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import time
 
 import numpy as np
