@@ -424,3 +424,45 @@ def test_blocked_dropout_expectation_matches_no_dropout():
     assert np.abs(avg - np.asarray(base)).mean() < (
         0.05 * np.abs(np.asarray(base)).mean() + 0.05
     )
+
+
+def test_flash_fwd_identical_with_and_without_lse():
+    """The training forward (want_lse=True) must produce EXACTLY the same
+    attention output as the plain forward — the lse write is an extra
+    output, never a numerical change (fused and blocked regimes)."""
+    from ml_recipe_tpu.ops.flash_attention import _blocked_fwd_cfg, _flash_forward, _blocked_forward
+
+    for B, L, H in ((2, 128, 4), (1, 1024, 2)):
+        q, k, v, mask = _qkv(B=B, L=L, H=H)
+        seed = jnp.asarray([3], jnp.int32)
+        if L <= 512:
+            plain = _flash_forward(q, k, v, mask, seed, jnp.float32, 0.2, True)
+            with_lse, lse = _flash_forward(
+                q, k, v, mask, seed, jnp.float32, 0.2, True, want_lse=True
+            )
+            assert lse.shape == (B, H, L, 1)
+        else:
+            D = q.shape[-1]
+            isz = q.dtype.itemsize
+            cfg = _blocked_fwd_cfg(L, H, D, isz, isz, 0.2)
+            assert cfg is not None, (L, H, D)
+            plain = _blocked_forward(
+                q, k, v, mask, seed, *cfg, jnp.float32, 0.2, True
+            )
+            with_lse, lse = _blocked_forward(
+                q, k, v, mask, seed, *cfg, jnp.float32, 0.2, True,
+                want_lse=True,
+            )
+            assert lse.shape == (B, H, L, 1)
+        np.testing.assert_array_equal(np.asarray(plain), np.asarray(with_lse))
+        # lse really is each row's logsumexp: exp(s - lse) rows sum to 1 on
+        # valid rows — check via the XLA reference scores for one head
+        valid = np.asarray(mask[0]).astype(bool)
+        qh = np.asarray(q[0, :, 0, :], np.float64)
+        kh = np.asarray(k[0, :, 0, :], np.float64)
+        s = (qh @ kh.T) / np.sqrt(q.shape[-1])
+        s[:, ~valid] = -1e30
+        ref_lse = np.log(np.exp(s - s.max(-1, keepdims=True)).sum(-1)) + s.max(-1)
+        np.testing.assert_allclose(
+            np.asarray(lse[0, 0, :, 0]), ref_lse, rtol=1e-4, atol=1e-4
+        )
