@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -32,6 +33,112 @@ V100_EXAMPLES_PER_SEC_EST = 100.0  # nominal single-V100 bert-base QA fine-tune
 # nominal single-V100 bert-base fp16 INFERENCE, ~3x its fine-tune rate (no
 # backward, no optimizer) — same provenance caveat as the train estimate
 V100_INFER_CHUNKS_PER_SEC_EST = 300.0
+
+
+def _acquire_backend(max_tries: int = 5, base_delay_s: float = 10.0,
+                     hang_timeout_s: float = 120.0):
+    """``jax.devices()`` with bounded retry-with-backoff and a hang watchdog.
+
+    The tunneled TPU backend has two observed outage modes (BENCH_r03.json
+    and this round): a fast ``UNAVAILABLE: TPU backend setup/compile error``
+    — the transient class retries exist for — and an indefinite HANG inside
+    backend init, which no retry can help (the hung thread holds the bridge
+    init lock) but which must still end in a legible structured failure
+    rather than the driver's process timeout. JAX caches a failed backend
+    init, so each retry clears the backend cache before re-dialing.
+
+    Honors a ``JAX_PLATFORMS`` env var through ``jax.config``: a
+    sitecustomize tunnel may pre-import jax and bake in its own platform
+    before the env the caller set can apply (the bench smoke tests run this
+    file in a subprocess with ``JAX_PLATFORMS=cpu`` for exactly that
+    reason).
+    """
+    import threading
+
+    import jax
+
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms:
+        try:
+            jax.config.update("jax_platforms", env_platforms)
+        except Exception:  # pragma: no cover - backend already initialized
+            pass
+
+    last: BaseException | None = None
+    for attempt in range(max_tries):
+        if attempt:
+            time.sleep(min(base_delay_s * (2 ** (attempt - 1)), 120.0))
+            _clear_backend_cache()
+        out: dict = {}
+
+        def _dial():
+            try:
+                out["devices"] = jax.devices()
+            except BaseException as e:  # noqa: BLE001 - reported below
+                out["err"] = e
+
+        t = threading.Thread(target=_dial, daemon=True)
+        t.start()
+        t.join(hang_timeout_s)
+        if t.is_alive():
+            # hung init: sticky (the dial thread keeps the init lock), so
+            # further retries would just block behind it — fail legibly now
+            raise RuntimeError(
+                f"UNAVAILABLE: backend init did not return within "
+                f"{hang_timeout_s:.0f}s (tunnel hang)"
+            )
+        if "devices" in out:
+            return out["devices"]
+        err = out["err"]
+        msg = str(err).lower()
+        transient = isinstance(err, RuntimeError) and (
+            "unavailable" in msg or "deadline" in msg
+        )
+        if not transient:
+            # a deterministic init error (bad platform name, version
+            # mismatch) re-dialed 5 times just burns ~150s of the driver's
+            # budget before the same failure — surface it immediately
+            raise err
+        last = err
+    assert last is not None
+    raise last
+
+
+def _clear_backend_cache() -> None:
+    """Drop JAX's cached backend-init failure so a retry re-dials.
+
+    jax 0.9 removed the public ``jax.extend.backend.clear_backends``; the
+    bridge-level helper is the remaining switch. Guarded: if the private API
+    drifts, the retry still runs (it just replays a cached error and the
+    failure stays legible via :func:`_emit_backend_failure`).
+    """
+    try:
+        from jax._src import xla_bridge
+
+        xla_bridge._clear_backends()
+    except Exception:  # pragma: no cover - private API drift
+        pass
+
+
+def _emit_backend_failure(err: BaseException) -> int:
+    """Structured failure line for a genuinely absent backend.
+
+    The driver records bench stdout; a parseable ``{"error": ...}`` object
+    beats a raw traceback when the TPU is down (VERDICT r3 #1). rc stays 1 —
+    the run IS a failure, just a legible one.
+    """
+    print(
+        json.dumps(
+            {
+                "metric": "bench_backend_unavailable",
+                "value": None,
+                "unit": None,
+                "vs_baseline": None,
+                "error": f"{type(err).__name__}: {err}",
+            }
+        )
+    )
+    return 1
 
 
 def bench_infer(args) -> None:
@@ -232,7 +339,10 @@ def bench_converge(args) -> None:
             for e in range(1, n_epochs + 1)
             if (e * spe - 1) in records
         ]
-        first_step_loss = records.get(0, loss_curve[0] if loss_curve else None)
+        # earliest recorded step, whatever its key — records.get(0, ...)
+        # would silently fall back to an end-of-epoch mean if the trainer's
+        # first recorded step key were ever nonzero (advisor r3)
+        first_step_loss = records[min(records)] if records else None
 
         final_map = float(mT["map"])
         print(
@@ -299,6 +409,11 @@ def main() -> None:
     parser.add_argument("--converge_warmup", type=float, default=0.2)
     parser.add_argument("--converge_examples", type=int, default=2048)
     args = parser.parse_args()
+
+    try:
+        _acquire_backend()
+    except RuntimeError as e:
+        return _emit_backend_failure(e)
 
     if args.mode == "infer":
         return bench_infer(args)
