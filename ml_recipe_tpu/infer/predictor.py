@@ -112,6 +112,21 @@ class Predictor:
             f"Buffer size: {self.buffer_size}. Set limit: {self.limit}."
         )
 
+    @staticmethod
+    def _check_ids_wire(packed, attention_mask, pad_id) -> None:
+        """The in-jit mask is ``(ids != pad_id)``; if a VALID position ever
+        carried the pad token id (e.g. literal "[PAD]" text surviving
+        tokenization), that derivation would silently diverge from collate's
+        row-length mask — fail loudly instead (advisor r3)."""
+        derived = packed != pad_id
+        if not np.array_equal(derived, np.asarray(attention_mask, bool)):
+            raise ValueError(
+                "ids-only wire precondition violated: pad_token_id occurs "
+                "at an attended position (or a padded position carries a "
+                "non-pad id); construct the Predictor without a tokenizer-"
+                "bound collate_fun to use the 3-plane wire"
+            )
+
     # -- compiled forward ------------------------------------------------------
 
     _OUT_KEYS = ("scores", "start_ids", "end_ids", "start_regs", "end_regs",
@@ -285,6 +300,9 @@ class Predictor:
                     if self._wire_ids_only:
                         packed = np.asarray(
                             inputs["input_ids"], np.uint16
+                        )
+                        self._check_ids_wire(
+                            packed, inputs["attention_mask"], self._pad_id
                         )
                         dev_inputs = make_global_array(packed, self.mesh)
                     else:

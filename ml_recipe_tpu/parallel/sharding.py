@@ -215,6 +215,16 @@ def _local_cover_shards(x) -> Optional[dict]:
         covered += vol
     if covered != total:
         return None
+    # volume-sum coverage is only sound if the de-duplicated bounds are
+    # pairwise disjoint; overlapping-but-unequal index ranges would
+    # double-count and leave unwritten np.empty garbage downstream. Not
+    # producible with this repo's NamedShardings, but the helper is generic
+    # over jax.Array (advisor r3).
+    keys = list(seen)
+    for i, a in enumerate(keys):
+        for b in keys[i + 1:]:
+            if all(a0 < b1 and b0 < a1 for (a0, a1), (b0, b1) in zip(a, b)):
+                return None
     return seen
 
 

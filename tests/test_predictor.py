@@ -226,3 +226,18 @@ def test_wire_formats_bit_exact(corpus_setup):
     np.testing.assert_array_equal(
         tt[valid], np.asarray(inputs["token_type_ids"])[valid]
     )
+
+
+def test_ids_wire_guard_rejects_pad_at_valid_position():
+    """A valid position whose token id equals pad_token_id would be silently
+    masked out by the in-jit (ids != pad) derivation — the wire guard turns
+    that divergence into a loud error (advisor r3)."""
+    pad_id = 0
+    ids = np.array([[5, 6, 0, 0]], dtype=np.uint16)
+    mask_ok = np.array([[1, 1, 0, 0]], dtype=np.int32)
+    Predictor._check_ids_wire(ids, mask_ok, pad_id)  # agreement: no raise
+
+    # literal pad id at an attended position
+    mask_attends_pad = np.array([[1, 1, 1, 0]], dtype=np.int32)
+    with pytest.raises(ValueError, match="ids-only wire precondition"):
+        Predictor._check_ids_wire(ids, mask_attends_pad, pad_id)
