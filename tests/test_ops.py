@@ -466,3 +466,113 @@ def test_flash_fwd_identical_with_and_without_lse():
         np.testing.assert_allclose(
             np.asarray(lse[0, 0, :, 0]), ref_lse, rtol=1e-4, atol=1e-4
         )
+
+
+@pytest.mark.unit
+def test_fused_bwd_accounting_no_excluded_terms():
+    """VERDICT r3 #3: the fused-backward VMEM accounting counts EVERY block
+    (including the lane-padded lse input) against the measured ceiling, and
+    every shipped training geometry fits the budget at a pick no smaller
+    than the round-3 measured ones (hc=6 for bert-base: the perf numbers
+    were recorded there, so the honest accounting must not regress it)."""
+    from ml_recipe_tpu.models import MODEL_PRESETS
+    from ml_recipe_tpu.ops.flash_attention import (
+        _FUSED_BWD_TEMPS,
+        _VMEM_BUDGET_FUSED_BWD,
+        _VMEM_CEILING,
+        _fused_bwd_bytes_per_head,
+        _pick_head_chunk,
+    )
+
+    # the lse term is present: the helper must grow with the lane padding
+    assert (
+        _fused_bwd_bytes_per_head(512, 64, 2)
+        - 2 * 512 * 64 * 7 * 2
+        == 2 * 512 * 128 * 4
+    )
+    assert _VMEM_BUDGET_FUSED_BWD < _VMEM_CEILING  # real margin, not zero
+
+    expected_min_hc = {"bert-tiny": 2, "bert-base-uncased": 6,
+                       "bert-large-uncased": 4, "roberta-base": 6,
+                       "roberta-large": 4}
+    for name, cfg in MODEL_PRESETS.items():
+        H, D = cfg.num_heads, cfg.head_dim
+        L = 512  # the fused-backward regime's ceiling shape
+        hc = _pick_head_chunk(
+            H, D,
+            bytes_per_head=_fused_bwd_bytes_per_head(L, D, 2),  # bf16
+            temp_bytes=_FUSED_BWD_TEMPS * L * L * 4,
+            budget=_VMEM_BUDGET_FUSED_BWD,
+        )
+        assert hc >= expected_min_hc[name], (name, hc)
+        # and the pick genuinely fits the budget — no excluded term makes
+        # the inequality hold by omission
+        assert (
+            _fused_bwd_bytes_per_head(L, D, 2) * hc
+            + _FUSED_BWD_TEMPS * L * L * 4
+            <= _VMEM_BUDGET_FUSED_BWD
+        ), name
+
+
+@pytest.mark.unit
+def test_fused_bwd_hc_probe_halves_on_vmem_overflow(monkeypatch):
+    """The compile probe must walk down the legal head chunks when Mosaic
+    rejects the arithmetic's pick, and cache the verdicts."""
+    from ml_recipe_tpu.ops import flash_attention as fa
+
+    monkeypatch.setattr(fa.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(fa, "_probe_results", {})
+
+    compiled = []
+
+    class _FakeLowered:
+        def __init__(self, hc):
+            self.hc = hc
+
+        def compile(self):
+            compiled.append(self.hc)
+            if self.hc > 2:  # pretend only hc<=2 fits on "hardware"
+                raise RuntimeError(
+                    "Mosaic failed: scoped vmem limit exceeded (RESOURCE_EXHAUSTED)"
+                )
+
+    class _FakeJitted:
+        def __init__(self, hc):
+            self.hc = hc
+
+        def lower(self, *args):
+            return _FakeLowered(self.hc)
+
+    hcs_built = []
+
+    def fake_build(B, L, H, D, in_dtype, rate, hc, interpret):
+        hcs_built.append(hc)
+        return hc
+
+    monkeypatch.setattr(fa, "_build_fused_bwd_call", fake_build)
+    monkeypatch.setattr(fa.jax, "jit", lambda hc: _FakeJitted(hc))
+
+    hc = fa._fused_bwd_hc(4, 512, 12, 64, jnp.bfloat16, jnp.int32, 0.1,
+                          interpret=False)
+    assert hc == 2
+    assert compiled == [6, 4, 2]  # walked down the legal chunks
+    # second call (different B): cached — feasibility is B-independent
+    hc2 = fa._fused_bwd_hc(16, 512, 12, 64, jnp.bfloat16, jnp.int32, 0.1,
+                           interpret=False)
+    assert hc2 == 2 and compiled == [6, 4, 2]
+
+    # a non-VMEM compile error must NOT be swallowed
+    monkeypatch.setattr(fa, "_probe_results", {})
+
+    class _FakeLoweredBoom(_FakeLowered):
+        def compile(self):
+            raise RuntimeError("lowering failed: unrelated mosaic bug")
+
+    class _FakeJittedBoom(_FakeJitted):
+        def lower(self, *args):
+            return _FakeLoweredBoom(self.hc)
+
+    monkeypatch.setattr(fa.jax, "jit", lambda hc: _FakeJittedBoom(hc))
+    with pytest.raises(RuntimeError, match="unrelated"):
+        fa._fused_bwd_hc(4, 512, 12, 64, jnp.bfloat16, jnp.int32, 0.1,
+                         interpret=False)
