@@ -62,7 +62,7 @@ class TP:
 
 def _make_trainer(tmp_path, *, batch_split=1, n_epochs=1, debug=False,
                   train_len=32, test_len=10, dropout=0.1, tp_cls=TP,
-                  mesh_spec="data:8", **trainer_extra):
+                  mesh_spec="data:8", attention_impl="xla", **trainer_extra):
     tokenizer = make_tokenizer(tmp_path)
     rng = np.random.default_rng(0)
     train_ds = DummyDataset(
@@ -79,9 +79,12 @@ def _make_trainer(tmp_path, *, batch_split=1, n_epochs=1, debug=False,
         intermediate_size=32, max_position_embeddings=MAX_SEQ_LEN + 2, num_labels=5,
         hidden_dropout_prob=dropout, attention_probs_dropout_prob=dropout,
     )
-    model = QAModel(cfg)
+    mesh = build_mesh(mesh_spec)
+    model = QAModel(cfg, attention_impl=attention_impl, mesh=mesh)
     sample = train_ds[0]
-    params = model.init(
+    # init through the XLA-attention twin: params are impl-independent, and
+    # ring's shard_map cannot shard the [1, L] init batch over the data axis
+    params = QAModel(cfg).init(
         jax.random.key(0),
         np.asarray(sample.input_ids, dtype=np.int32)[None, :],
     )["params"]
@@ -94,7 +97,7 @@ def _make_trainer(tmp_path, *, batch_split=1, n_epochs=1, debug=False,
         trainer_params=tp_cls(),
         train_dataset=train_ds,
         test_dataset=test_ds,
-        mesh=build_mesh(mesh_spec),
+        mesh=mesh,
         n_epochs=n_epochs,
         train_batch_size=16,
         test_batch_size=8,
