@@ -114,7 +114,8 @@ def dot_product_attention(
             L, H, D, in_isz, out_isz, dropout_rate
         ) and (
             dropout_rate == 0.0
-            or supports_blocked_bwd(L, H, D, in_isz, dropout_rate)
+            or supports_blocked_bwd(L, H, D, in_isz, dropout_rate,
+                                    out_itemsize=out_isz)
         )
         shapes_ok = supports_fused_bwd(L) or blocked_ok
 

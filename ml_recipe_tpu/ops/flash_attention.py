@@ -152,13 +152,20 @@ def _fused_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, :, sl] = o.astype(o_ref.dtype)
 
 
-def _attention_bwd_math(q, k, v, g, mask, scale, *, drop=None, lse=None):
+def _attention_bwd_math(q, k, v, g, mask, scale, *, drop=None, lse=None,
+                        out=None):
     """Exact softmax-attention backward for one head, probabilities
     recomputed in VMEM. ``q``/``g`` may be a q-block; ``k``/``v`` are the
     full rows. ``drop``: optional ``(keep_bool_grid, inv_rate)`` applying
     the forward's dropout in-kernel. ``lse``: optional [q_rows, 1] per-row
     logsumexp saved by the forward — probabilities then come from ONE
     ``exp(s - lse)`` instead of the max/sum/divide normalization sweeps.
+    ``out``: optional [q_rows, D] forward output rows — the softmax-backward
+    row term then comes from the FlashAttention-2 delta identity
+    ``row_i = g_i . out_i`` (one [q_rows, D] multiply-reduce) instead of a
+    full [q_rows, L] ``sum(dp * p)`` pass; the identity holds WITH dropout
+    (sum_j keep*inv*dp_drop * p = sum_j dp_drop * p_drop = g.out — same
+    derivation as ring_attention.py's backward).
     Returns ``(dq, dk, dv)`` in f32, where dk/dv have k's row count."""
     if lse is not None:
         s = jax.lax.dot_general(
@@ -189,7 +196,13 @@ def _attention_bwd_math(q, k, v, g, mask, scale, *, drop=None, lse=None):
         dp = jnp.where(keep, dp_drop * inv, 0.0)
     else:
         dp = dp_drop
-    row = jnp.sum(dp * p, axis=-1, keepdims=True)
+    if out is not None:
+        row = jnp.sum(
+            g.astype(jnp.float32) * out.astype(jnp.float32),
+            axis=-1, keepdims=True,
+        )
+    else:
+        row = jnp.sum(dp * p, axis=-1, keepdims=True)
     ds = p * (dp - row)  # f32; zero on masked keys since p is zero there
 
     dq = jax.lax.dot_general(
@@ -204,12 +217,14 @@ def _attention_bwd_math(q, k, v, g, mask, scale, *, drop=None, lse=None):
 
 
 def _fused_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
-                      lse_ref, dq_ref, dk_ref, dv_ref,
+                      out_ref, lse_ref, dq_ref, dk_ref, dv_ref,
                       *, scale: float, rate: float, hc: int,
                       D: int):
     """One (batch, head-group) program: exact attention backward for ``hc``
     heads, recomputing the probabilities from the forward's saved per-row
-    logsumexp (and regenerating the identical dropout mask) in VMEM.
+    logsumexp (and regenerating the identical dropout mask) in VMEM; the
+    softmax row term comes from the saved forward output via the delta
+    identity (one [L, D] pass instead of an [L, L] one).
     Folded [B, L, H*D] layout like the forward."""
     b, hj = pl.program_id(0), pl.program_id(1)
     mask = mask_ref[0, 0, :]
@@ -228,7 +243,8 @@ def _fused_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
             drop = (keep, jnp.float32(1.0 / (1.0 - rate)))
 
         dq, dk, dv = _attention_bwd_math(
-            q, k, v, g, mask, scale, drop=drop, lse=lse_ref[0, h, :, :]
+            q, k, v, g, mask, scale, drop=drop, lse=lse_ref[0, h, :, :],
+            out=out_ref[0, :, sl],
         )
 
         dq_ref[0, :, sl] = dq.astype(dq_ref.dtype)
@@ -237,7 +253,7 @@ def _fused_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
 
 
 def _blocked_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
-                        lse_ref, dq_ref, dk_ref, dv_ref,
+                        out_ref, lse_ref, dq_ref, dk_ref, dv_ref,
                         *, scale: float, rate: float, hc: int,
                         D: int):
     """Fused long-sequence backward: one (batch, head-group, q-block)
@@ -271,6 +287,7 @@ def _blocked_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
             v_ref[0, :, sl],   # [L, D] (whole)
             g_ref[0, :, sl],   # [q_blk, D]
             mask, scale, drop=drop, lse=lse_ref[0, h, :, :],
+            out=out_ref[0, :, sl],  # [q_blk, D]
         )
 
         dq_ref[0, :, sl] = dq.astype(dq_ref.dtype)
@@ -456,15 +473,21 @@ def _flash_forward(q, k, v, mask, seed, dtype, rate, interpret: bool,
     return res[0].reshape(B, L, H, D)
 
 
-def _fused_bwd_bytes_per_head(L: int, D: int, itemsize: int) -> int:
-    """Per-head double-buffered block bytes of the fused backward: the seven
-    [L, hc*D] operand/output blocks (q k v g dq dk dv) plus the lane-padded
+def _fused_bwd_bytes_per_head(L: int, D: int, itemsize: int,
+                              out_itemsize: int) -> int:
+    """Per-head double-buffered block bytes of the fused backward: seven
+    [L, hc*D] blocks in the input dtype (q k v g dq dk dv), the out block in
+    the FORWARD OUTPUT dtype (delta-identity row term), and the lane-padded
     [hc, L, 1] lse input block ((8, 128) tiles: L*128*4 per head) — EVERY
-    block counted, same discipline as the forward and blocked cfgs."""
-    return 2 * L * D * 7 * itemsize + 2 * L * 128 * 4
+    block counted at its own itemsize, same discipline as the forward and
+    blocked cfgs."""
+    return (2 * L * D * 7 * itemsize + 2 * L * D * out_itemsize
+            + 2 * L * 128 * 4)
 
 
-_FUSED_BWD_TEMPS = 6  # s/p/keep/dp/ds f32 working set, in [L, L] units
+# s/p/keep/dp/ds f32 working set, in [L, L] units (the delta-identity row
+# term reads the [L, D] out block instead of materializing a dp*p grid)
+_FUSED_BWD_TEMPS = 5
 
 
 def _build_fused_bwd_call(B, L, H, D, in_dtype, rate, hc, interpret):
@@ -479,7 +502,7 @@ def _build_fused_bwd_call(B, L, H, D, in_dtype, rate, hc, interpret):
             grid=(B, H // hc),
             in_specs=[
                 pl.BlockSpec((1, 1, L), lambda b, hj, *_: (b, 0, 0)),  # mask
-                spec_lf, spec_lf, spec_lf, spec_lf,                    # q k v g
+                spec_lf, spec_lf, spec_lf, spec_lf, spec_lf,   # q k v g out
                 pl.BlockSpec((1, hc, L, 1), lambda b, hj, *_: (b, hj, 0, 0)),  # lse
             ],
             out_specs=[spec_lf, spec_lf, spec_lf],
@@ -500,7 +523,8 @@ def _looks_like_vmem_overflow(err: Exception) -> bool:
 _probe_results: dict = {}
 
 
-def _fused_bwd_hc(B, L, H, D, in_dtype, mask_dtype, rate, interpret) -> int:
+def _fused_bwd_hc(B, L, H, D, in_dtype, mask_dtype, out_dtype, rate,
+                  interpret) -> int:
     """Head-chunk choice for the fused backward: full accounting against the
     measured scoped-VMEM ceiling, then a cached compile probe on real TPU —
     if Mosaic rejects the arithmetic's pick, halve to the next legal chunk
@@ -515,7 +539,9 @@ def _fused_bwd_hc(B, L, H, D, in_dtype, mask_dtype, rate, interpret) -> int:
     itemsize = jnp.dtype(in_dtype).itemsize
     hc = _pick_head_chunk(
         H, D,
-        bytes_per_head=_fused_bwd_bytes_per_head(L, D, itemsize),
+        bytes_per_head=_fused_bwd_bytes_per_head(
+            L, D, itemsize, jnp.dtype(out_dtype).itemsize
+        ),
         temp_bytes=_FUSED_BWD_TEMPS * L * L * 4,
         budget=_VMEM_BUDGET_FUSED_BWD,
     )
@@ -524,13 +550,15 @@ def _fused_bwd_hc(B, L, H, D, in_dtype, mask_dtype, rate, interpret) -> int:
 
     legal = sorted(_legal_head_chunks(H, D))
     while True:
-        key = (L, H, D, str(in_dtype), str(mask_dtype), rate > 0.0, hc)
+        key = (L, H, D, str(in_dtype), str(mask_dtype), str(out_dtype),
+               rate > 0.0, hc)
         ok = _probe_results.get(key)
         if ok is None:
             args = [
                 jax.ShapeDtypeStruct((1,), jnp.int32),          # row seeds
                 jax.ShapeDtypeStruct((1, 1, L), mask_dtype),    # mask
                 *[jax.ShapeDtypeStruct((1, L, H * D), in_dtype)] * 4,  # qkvg
+                jax.ShapeDtypeStruct((1, L, H * D), out_dtype),  # out
                 jax.ShapeDtypeStruct((1, H, L, 1), jnp.float32),  # lse
             ]
             call = _build_fused_bwd_call(1, L, H, D, in_dtype, rate, hc,
@@ -551,14 +579,15 @@ def _fused_bwd_hc(B, L, H, D, in_dtype, mask_dtype, rate, interpret) -> int:
         hc = max(smaller)
 
 
-def _flash_backward(q, k, v, mask, seed, g, lse, dtype, rate,
+def _flash_backward(q, k, v, mask, seed, g, out, lse, dtype, rate,
                     interpret: bool):
     B, L, H, D = q.shape
-    hc = _fused_bwd_hc(B, L, H, D, q.dtype, mask.dtype, rate, interpret)
+    hc = _fused_bwd_hc(B, L, H, D, q.dtype, mask.dtype, out.dtype, rate,
+                       interpret)
     dq, dk, dv = _build_fused_bwd_call(B, L, H, D, q.dtype, rate, hc,
                                        interpret)(
         _row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k),
-        _fold(v), _fold(g), lse)
+        _fold(v), _fold(g), _fold(out), lse)
     return tuple(x.reshape(B, L, H, D) for x in (dq, dk, dv))
 
 
@@ -647,47 +676,62 @@ def _blocked_forward(q, k, v, mask, seed, q_blk, hc, dtype, rate,
 
 
 def _blocked_bwd_cfg(L: int, H: int, D: int, in_itemsize: int,
-                     rate: float = 0.0):
+                     rate: float = 0.0, out_itemsize: int | None = None):
     """(q_blk, hc) for the fused q-blocked backward, or ``None`` when no
     configuration fits the VMEM budget (the caller then falls back to the
     XLA-recompute backward instead of letting Mosaic OOM on hardware).
 
-    Working set per program: [q_blk, L] f32 temporaries (p, dp, ds + softmax
-    scratch, ~4 deep, + the dropout keep grid when ``rate > 0``); blocks:
-    q/g/dq at q_blk rows and k/v at L rows (input dtype, double-buffered),
-    dk/dv at L rows in f32 (revisited accumulators, not double-buffered)."""
-    q_blk = _pick_q_block(L)
-    if q_blk is None:
+    Working set per program: [q_blk, L] f32 temporaries — 3 live grids
+    (p, dp, ds; the delta-identity row term needs no dp*p grid) PLUS one
+    grid of deliberate margin, because unlike the fused path this path has
+    NO compile probe: the paper arithmetic is the only gate, so it must not
+    run the budget to the wire — + the dropout keep grid when ``rate > 0``;
+    blocks: q/g/dq at q_blk rows and k/v at L rows (input dtype), out at
+    q_blk rows in the FORWARD OUTPUT dtype, all double-buffered; dk/dv at L
+    rows in f32 (revisited accumulators, not double-buffered)."""
+    if out_itemsize is None:
+        out_itemsize = in_itemsize
+    q_blk0 = _pick_q_block(L)
+    if q_blk0 is None:
         return None
     n_temps = 4 + (1 if rate > 0.0 else 0)
-    while q_blk > 128 and n_temps * q_blk * L * 4 > _VMEM_BUDGET // 2:
+    while q_blk0 > 128 and n_temps * q_blk0 * L * 4 > _VMEM_BUDGET // 2:
+        q_blk0 //= 2
+    # outer q_blk walk: a q-block that satisfies the temp budget can still
+    # blow the BLOCK budget once the per-row streams (q/g/out/dq + lse) are
+    # added — shrink further before declining the shape entirely
+    q_blk = q_blk0
+    while q_blk >= 128:
+        temp_bytes = n_temps * q_blk * L * 4
+        for hc in sorted(_legal_head_chunks(H, D), reverse=True):
+            block_bytes = hc * D * (
+                2 * (2 * L + 3 * q_blk) * in_itemsize
+                + 2 * q_blk * out_itemsize + 2 * L * 4
+            )
+            # lane-padded [1, hc, q_blk, 1] lse input block (see fwd cfg)
+            block_bytes += hc * 2 * q_blk * 128 * 4
+            if block_bytes + temp_bytes <= _VMEM_BUDGET:
+                return q_blk, hc
         q_blk //= 2
-    temp_bytes = n_temps * q_blk * L * 4
-    for hc in sorted(_legal_head_chunks(H, D), reverse=True):
-        block_bytes = hc * D * (
-            2 * (2 * L + 3 * q_blk) * in_itemsize + 2 * L * 4
-        )
-        # lane-padded [1, hc, q_blk, 1] lse input block (see fwd cfg)
-        block_bytes += hc * 2 * q_blk * 128 * 4
-        if block_bytes + temp_bytes <= _VMEM_BUDGET:
-            return q_blk, hc
     return None
 
 
 def supports_blocked_bwd(L: int, H: int, D: int, in_itemsize: int,
-                         rate: float = 0.0) -> bool:
+                         rate: float = 0.0,
+                         out_itemsize: int | None = None) -> bool:
     """True when the fused q-blocked backward has a VMEM-feasible
-    configuration for this exact head geometry and input itemsize (no
-    defaults: a bert-base answer for a different geometry would be
+    configuration for this exact head geometry and input/output itemsizes
+    (no defaults: a bert-base answer for a different geometry would be
     silently wrong)."""
     return (
         L > _FUSED_BWD_MAX_LEN
-        and _blocked_bwd_cfg(L, H, D, in_itemsize, rate) is not None
+        and _blocked_bwd_cfg(L, H, D, in_itemsize, rate,
+                             out_itemsize=out_itemsize) is not None
     )
 
 
-def _blocked_backward(q, k, v, mask, seed, g, lse, q_blk, hc, dtype, rate,
-                      interpret: bool):
+def _blocked_backward(q, k, v, mask, seed, g, out, lse, q_blk, hc, dtype,
+                      rate, interpret: bool):
     B, L, H, D = q.shape
 
     spec_q = pl.BlockSpec((1, q_blk, hc * D), lambda b, hj, qi, *_: (b, qi, hj))
@@ -704,6 +748,7 @@ def _blocked_backward(q, k, v, mask, seed, g, lse, q_blk, hc, dtype, rate,
                 spec_q,                                                # q block
                 spec_l, spec_l,                                        # k v whole
                 spec_q,                                                # g block
+                spec_q,                                                # out block
                 pl.BlockSpec((1, hc, q_blk, 1),
                              lambda b, hj, qi, *_: (b, hj, qi, 0)),    # lse
             ],
@@ -716,7 +761,7 @@ def _blocked_backward(q, k, v, mask, seed, g, lse, q_blk, hc, dtype, rate,
         ],
         interpret=interpret,
     )(_row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v),
-      _fold(g), lse)
+      _fold(g), _fold(out), lse)
     return (
         dq.reshape(B, L, H, D),
         dk.reshape(B, L, H, D).astype(k.dtype),
@@ -753,12 +798,16 @@ def _fwd(q, k, v, mask, seed, dtype, rate, interpret):
     B, L, H, D = q.shape
     if supports_fused_bwd(L):
         # the forward also emits per-row logsumexp so the backward skips
-        # the max/sum/divide normalization sweeps
+        # the max/sum/divide normalization sweeps; the output itself is a
+        # residual too (delta identity row term) — XLA already keeps it
+        # alive for the output projection's weight grad, so this adds no
+        # HBM-resident tensor
         out, lse = _flash_forward(
             q, k, v, mask, seed, dtype, rate, interpret, want_lse=True
         )
-        return out, (q, k, v, mask, seed, lse)
-    if supports_blocked_bwd(L, H, D, q.dtype.itemsize, rate):
+        return out, (q, k, v, mask, seed, out, lse)
+    if supports_blocked_bwd(L, H, D, q.dtype.itemsize, rate,
+                            out_itemsize=jnp.dtype(dtype).itemsize):
         cfg = _blocked_fwd_cfg(
             L, H, D, q.dtype.itemsize, jnp.dtype(dtype).itemsize, rate
         )
@@ -767,27 +816,27 @@ def _fwd(q, k, v, mask, seed, dtype, rate, interpret):
                 q, k, v, mask, seed, *cfg, dtype, rate, interpret,
                 want_lse=True,
             )
-            return out, (q, k, v, mask, seed, lse)
+            return out, (q, k, v, mask, seed, out, lse)
     out = _flash_core(q, k, v, mask, seed, dtype, rate, interpret)
-    return out, (q, k, v, mask, seed, None)
+    return out, (q, k, v, mask, seed, None, None)
 
 
 def _bwd(dtype, rate, interpret, residuals, g):
-    q, k, v, mask, seed, lse = residuals
-    L = q.shape[1]
+    q, k, v, mask, seed, out, lse = residuals
+    L, H, D = q.shape[1], q.shape[2], q.shape[3]
     if supports_fused_bwd(L):
         dq, dk, dv = _flash_backward(
-            q, k, v, mask, seed, g.astype(q.dtype), lse, dtype, rate,
+            q, k, v, mask, seed, g.astype(q.dtype), out, lse, dtype, rate,
             interpret,
         )
         return dq, dk, dv, None, None
     if L > _FUSED_BWD_MAX_LEN and lse is not None:
-        H, D = q.shape[2], q.shape[3]
-        cfg = _blocked_bwd_cfg(L, H, D, q.dtype.itemsize, rate)
+        cfg = _blocked_bwd_cfg(L, H, D, q.dtype.itemsize, rate,
+                               out_itemsize=jnp.dtype(dtype).itemsize)
         if cfg is not None:
             dq, dk, dv = _blocked_backward(
-                q, k, v, mask, seed, g.astype(q.dtype), lse, *cfg, dtype,
-                rate, interpret,
+                q, k, v, mask, seed, g.astype(q.dtype), out, lse, *cfg,
+                dtype, rate, interpret,
             )
             return dq, dk, dv, None, None
     if rate > 0.0:

@@ -335,7 +335,9 @@ def test_blocked_bwd_cfg_feasibility():
     q_blk, hc = cfg
     assert 2048 % q_blk == 0 and 12 % hc == 0
     assert (hc * 64) % 128 == 0
-    # too big for VMEM at bf16/D=64 -> must decline
+    # too big for VMEM at bf16/D=64 -> must decline. This path has no
+    # compile probe, so the cfg keeps a margin temp grid and the r3
+    # boundary stands even though the delta identity shrank the live set.
     assert _blocked_bwd_cfg(4096, 12, 64, 2) is None
     assert _blocked_bwd_cfg(3072, 12, 64, 2) is None
     # f32 inputs double the block bytes -> declines earlier
@@ -485,10 +487,17 @@ def test_fused_bwd_accounting_no_excluded_terms():
     )
 
     # the lse term is present: the helper must grow with the lane padding
+    # (7 in-dtype streams q k v g dq dk dv + the out stream at its own
+    # itemsize — mixed-precision out must not be undercounted)
     assert (
-        _fused_bwd_bytes_per_head(512, 64, 2)
-        - 2 * 512 * 64 * 7 * 2
+        _fused_bwd_bytes_per_head(512, 64, 2, 2)
+        - 2 * 512 * 64 * 8 * 2
         == 2 * 512 * 128 * 4
+    )
+    assert (
+        _fused_bwd_bytes_per_head(512, 64, 2, 4)
+        - _fused_bwd_bytes_per_head(512, 64, 2, 2)
+        == 2 * 512 * 64 * 2
     )
     assert _VMEM_BUDGET_FUSED_BWD < _VMEM_CEILING  # real margin, not zero
 
@@ -500,7 +509,7 @@ def test_fused_bwd_accounting_no_excluded_terms():
         L = 512  # the fused-backward regime's ceiling shape
         hc = _pick_head_chunk(
             H, D,
-            bytes_per_head=_fused_bwd_bytes_per_head(L, D, 2),  # bf16
+            bytes_per_head=_fused_bwd_bytes_per_head(L, D, 2, 2),  # bf16
             temp_bytes=_FUSED_BWD_TEMPS * L * L * 4,
             budget=_VMEM_BUDGET_FUSED_BWD,
         )
@@ -508,7 +517,7 @@ def test_fused_bwd_accounting_no_excluded_terms():
         # and the pick genuinely fits the budget — no excluded term makes
         # the inequality hold by omission
         assert (
-            _fused_bwd_bytes_per_head(L, D, 2) * hc
+            _fused_bwd_bytes_per_head(L, D, 2, 2) * hc
             + _FUSED_BWD_TEMPS * L * L * 4
             <= _VMEM_BUDGET_FUSED_BWD
         ), name
@@ -552,13 +561,13 @@ def test_fused_bwd_hc_probe_halves_on_vmem_overflow(monkeypatch):
     monkeypatch.setattr(fa, "_build_fused_bwd_call", fake_build)
     monkeypatch.setattr(fa.jax, "jit", lambda hc: _FakeJitted(hc))
 
-    hc = fa._fused_bwd_hc(4, 512, 12, 64, jnp.bfloat16, jnp.int32, 0.1,
-                          interpret=False)
+    hc = fa._fused_bwd_hc(4, 512, 12, 64, jnp.bfloat16, jnp.int32,
+                          jnp.bfloat16, 0.1, interpret=False)
     assert hc == 2
     assert compiled == [6, 4, 2]  # walked down the legal chunks
     # second call (different B): cached — feasibility is B-independent
-    hc2 = fa._fused_bwd_hc(16, 512, 12, 64, jnp.bfloat16, jnp.int32, 0.1,
-                           interpret=False)
+    hc2 = fa._fused_bwd_hc(16, 512, 12, 64, jnp.bfloat16, jnp.int32,
+                           jnp.bfloat16, 0.1, interpret=False)
     assert hc2 == 2 and compiled == [6, 4, 2]
 
     # a non-VMEM compile error must NOT be swallowed
@@ -574,5 +583,5 @@ def test_fused_bwd_hc_probe_halves_on_vmem_overflow(monkeypatch):
 
     monkeypatch.setattr(fa.jax, "jit", lambda hc: _FakeJittedBoom(hc))
     with pytest.raises(RuntimeError, match="unrelated"):
-        fa._fused_bwd_hc(4, 512, 12, 64, jnp.bfloat16, jnp.int32, 0.1,
-                         interpret=False)
+        fa._fused_bwd_hc(4, 512, 12, 64, jnp.bfloat16, jnp.int32,
+                         jnp.bfloat16, 0.1, interpret=False)
