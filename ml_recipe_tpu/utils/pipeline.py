@@ -26,24 +26,41 @@ class LaggedConsumer:
     progress displays that close with the loop still include the last item.
     ``flush()`` consumes all stored items; call it after the loop (covers
     early exits and unknown-length streams) — it is idempotent.
+
+    ``group > 1`` switches to GROUPED consumption: once ``depth`` items are
+    in flight past a full group, the oldest ``group`` feeds are delivered in
+    ONE call as ``consume([args, args, ...])`` (and ``flush`` delivers the
+    tail the same way, possibly short). Use when the consumer can amortize a
+    per-call cost — e.g. one device->host round trip — over the whole group.
     """
 
     def __init__(self, consume: Callable[..., None], total: Optional[int] = None,
-                 depth: int = 1):
+                 depth: int = 1, group: int = 1):
         self._consume = consume
         self._total = total
         self._depth = max(1, depth)
+        self._group = max(1, group)
         self._fed = 0
         self._pending: deque = deque()
 
+    def _deliver_oldest(self, count: int) -> None:
+        if self._group == 1:
+            for _ in range(count):
+                self._consume(*self._pending.popleft())
+        else:
+            batch = [self._pending.popleft() for _ in range(count)]
+            self._consume(batch)
+
     def feed(self, *args) -> None:
         self._pending.append(args)
-        while len(self._pending) > self._depth:
-            self._consume(*self._pending.popleft())
+        while len(self._pending) >= self._depth + self._group:
+            self._deliver_oldest(self._group)
         self._fed += 1
         if self._total is not None and self._fed >= self._total:
             self.flush()
 
     def flush(self) -> None:
         while self._pending:
-            self._consume(*self._pending.popleft())
+            self._deliver_oldest(
+                min(self._group, len(self._pending))
+            )

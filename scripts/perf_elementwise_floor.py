@@ -122,14 +122,15 @@ def main() -> int:
         inputs = trainer._global_batch(host_inputs, leading_accum=True)
         labels = trainer._global_batch(host_labels, leading_accum=True)
         params_d, opt_d = trainer.params, trainer.opt_state
-        for i in range(args.warmup):
+        warmup = max(1, args.warmup)  # >=1: compile must precede the trace
+        for i in range(warmup):
             params_d, opt_d, values = step_fn(params_d, opt_d, inputs,
                                               labels, i)
         float(values["loss"])  # tunnel-safe sync
         with jax.profiler.trace(trace_dir):
             for i in range(args.steps):
                 params_d, opt_d, values = step_fn(
-                    params_d, opt_d, inputs, labels, args.warmup + i)
+                    params_d, opt_d, inputs, labels, warmup + i)
             float(values["loss"])
 
     prof = _collect_op_profile(trace_dir)

@@ -241,3 +241,52 @@ def test_ids_wire_guard_rejects_pad_at_valid_position():
     mask_attends_pad = np.array([[1, 1, 1, 0]], dtype=np.int32)
     with pytest.raises(ValueError, match="ids-only wire precondition"):
         Predictor._check_ids_wire(ids, mask_attends_pad, pad_id)
+
+
+def test_fetch_grouping_invariant(corpus_setup, tmp_path):
+    """Grouped output fetching (fetch_every > 1, one transfer per group)
+    must produce IDENTICAL candidates/scores/dump to per-batch fetching —
+    only the transfer schedule changes, never the results or their order."""
+    from ml_recipe_tpu.data import RawPreprocessor
+    from ml_recipe_tpu.data.datasets import ChunkDataset
+
+    tok, _, corpus_tmp = corpus_setup
+    # a corpus big enough for SEVERAL batches (the module fixture's val
+    # split is a single chunk): all splits, short stride -> many chunks
+    pre = RawPreprocessor(
+        raw_json=write_corpus(
+            tmp_path, [nq_line(example_id=str(i)) for i in range(30)]
+        ),
+        out_dir=tmp_path / "proc",
+    )
+    _, _, (train_idx, _, val_idx, _) = pre()
+    indexes = np.concatenate([train_idx, val_idx])
+    dataset = ChunkDataset(
+        tmp_path / "proc", tok, indexes, max_seq_len=48, max_question_len=16,
+        doc_stride=8, split_by_sentence=False, cache_size=0,
+    )
+
+    model, params = _tiny_model(tok)
+    collate = init_collate_fun(tok, max_seq_len=48, return_items=True)
+    mesh = build_mesh()
+
+    def run(fetch_every):
+        p = Predictor(
+            model, params, mesh=mesh, collate_fun=collate, batch_size=8,
+            n_jobs=1, fetch_every=fetch_every,
+        )
+        p(dataset, save_dump=True)
+        return p
+
+    base = run(1)     # the pre-grouping behavior
+    grouped = run(3)  # drains 3 at a time with 2 in flight
+    assert len(base.dump) == len(grouped.dump) > 1
+    for (s_a, st_a, en_a, lb_a, it_a), (s_b, st_b, en_b, lb_b, it_b) in zip(
+        base.dump, grouped.dump
+    ):
+        np.testing.assert_array_equal(s_a, s_b)
+        np.testing.assert_array_equal(st_a, st_b)
+        np.testing.assert_array_equal(en_a, en_b)
+        np.testing.assert_array_equal(lb_a, lb_b)
+        assert [i.item_id for i in it_a] == [i.item_id for i in it_b]
+    assert base.scores == grouped.scores

@@ -97,3 +97,33 @@ def test_lagged_consumer_total_autoflushes():
     assert seen == [1, 2, 3]
     lag.flush()            # still idempotent afterwards
     assert seen == [1, 2, 3]
+
+
+def test_lagged_consumer_grouped_mode():
+    """group > 1: the oldest `group` feeds arrive in ONE consume([...])
+    call once `depth` newer items are in flight; flush delivers the tail
+    (possibly short); group=1 keeps the unpacked-args convention."""
+    from ml_recipe_tpu.utils.pipeline import LaggedConsumer
+
+    calls = []
+    lag = LaggedConsumer(lambda batch: calls.append(batch), depth=2, group=3)
+    for i in range(8):
+        lag.feed(i, f"item{i}")
+    # a full group is delivered each time group+depth feeds are pending,
+    # always keeping `depth` newest items in flight
+    assert calls == [
+        [(0, "item0"), (1, "item1"), (2, "item2")],
+        [(3, "item3"), (4, "item4"), (5, "item5")],
+    ]
+    lag.flush()
+    assert calls[2] == [(6, "item6"), (7, "item7")]  # short tail group
+    lag.flush()  # idempotent
+    assert len(calls) == 3
+
+    # group=1 unchanged: unpacked args, one-late delivery
+    single = []
+    lag1 = LaggedConsumer(lambda a, b: single.append((a, b)), depth=1)
+    lag1.feed(1, "a"); lag1.feed(2, "b")
+    assert single == [(1, "a")]
+    lag1.flush()
+    assert single == [(1, "a"), (2, "b")]
