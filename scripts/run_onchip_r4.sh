@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# Round-4 on-chip capture: run every measurement the off-chip session staged,
+# in one command, writing JSON artifacts to artifacts/r4/.
+#
+#   bash scripts/run_onchip_r4.sh            # full capture (~25 min)
+#   bash scripts/run_onchip_r4.sh quick      # skip converge + long-seq (~8 min)
+#
+# Produces:
+#   artifacts/r4/vmem_ceiling.json      scoped-VMEM ceiling (bisected)
+#   artifacts/r4/bench_seq512.json      train throughput (delta-identity bwd)
+#   artifacts/r4/bench_seq1024.json     long-context train (blocked kernels)
+#   artifacts/r4/bench_seq2048.json
+#   artifacts/r4/bench_infer.json       inference loop (grouped fetching)
+#   artifacts/r4/attn_bwd.json          per-kernel attention bwd ms
+#   artifacts/r4/elementwise_floor.json LN/GELU-bwd bytes-vs-peak
+#   artifacts/r4/infer_decomp.json      overlap decomposition through tunnel
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p artifacts/r4
+
+run() {  # run <name> <cmd...> — continue past single failures, keep the tail
+  local name="$1"; shift
+  echo "=== $name: $*" >&2
+  if "$@" > "artifacts/r4/$name.json.tmp" 2> "artifacts/r4/$name.log"; then
+    # keep only the JSON line the tools print last
+    grep "^{" "artifacts/r4/$name.json.tmp" | tail -1 > "artifacts/r4/$name.json"
+    rm -f "artifacts/r4/$name.json.tmp"
+    echo "    -> artifacts/r4/$name.json: $(cat artifacts/r4/$name.json)" >&2
+  else
+    echo "    FAILED (see artifacts/r4/$name.log)" >&2
+    mv "artifacts/r4/$name.json.tmp" "artifacts/r4/$name.failed" 2>/dev/null || true
+  fi
+}
+
+run vmem_ceiling      python scripts/measure_vmem_ceiling.py
+run bench_seq512      python bench.py
+run bench_infer       python bench.py --mode infer
+run attn_bwd          python scripts/perf_attn_bwd.py
+run elementwise_floor python scripts/perf_elementwise_floor.py
+
+if [ "${1:-full}" != "quick" ]; then
+  run bench_seq1024   python bench.py --seq_len 1024 --global_batch 128
+  run bench_seq2048   python bench.py --seq_len 2048 --global_batch 32
+  run infer_decomp    python scripts/perf_infer_decomposition.py \
+                        --model bert-base-uncased --seq_len 512 \
+                        --global_batch 256 --infer_docs 192 \
+                        --infer_doc_len 3000 --infer_jobs 16 --doc_stride 256
+fi
+
+echo "=== capture complete; artifacts in artifacts/r4/" >&2
