@@ -287,7 +287,10 @@ class Predictor:
         # that RTT over ``fetch_every`` batches. Multi-process runs fetch
         # per batch: their outputs are not fully addressable, and an eager
         # jnp.stack on such arrays is an error — gather_to_host handles
-        # them per array.
+        # them per array. (Defensive only: inference is a single-process
+        # workload here as in the reference — its validate.py has no
+        # distributed path — so the per-batch branch just prevents a crash
+        # class if a multi-process world ever constructs a Predictor.)
         import jax
 
         import jax.numpy as jnp
