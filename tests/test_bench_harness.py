@@ -111,3 +111,27 @@ def test_acquire_backend_fails_fast_on_deterministic_error(bench, monkeypatch):
         bench._acquire_backend(max_tries=5, base_delay_s=10.0)
     assert calls["devices"] == 1  # no retries
     assert sleeps == []
+
+
+def test_acquire_backend_hang_watchdog(bench, monkeypatch):
+    """Backend init that never returns (the observed round-4 tunnel outage
+    mode) must end in a legible RuntimeError after the watchdog window —
+    not an indefinite hang that becomes a driver process-timeout."""
+    import threading
+
+    import jax
+
+    release = threading.Event()
+
+    def hanging_devices():
+        release.wait(10)  # "never" returns within the watchdog window
+        return []
+
+    monkeypatch.setattr(jax, "devices", hanging_devices)
+    monkeypatch.setattr(bench, "_clear_backend_cache", lambda: None)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+
+    with pytest.raises(RuntimeError, match="did not return"):
+        bench._acquire_backend(max_tries=5, base_delay_s=1.0,
+                               hang_timeout_s=0.2)
+    release.set()  # unblock the daemon thread promptly
