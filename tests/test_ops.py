@@ -585,3 +585,21 @@ def test_fused_bwd_hc_probe_halves_on_vmem_overflow(monkeypatch):
     with pytest.raises(RuntimeError, match="unrelated"):
         fa._fused_bwd_hc(4, 512, 12, 64, jnp.bfloat16, jnp.int32,
                          jnp.bfloat16, 0.1, interpret=False)
+
+
+@pytest.mark.unit
+def test_blocked_bwd_cfg_counts_out_dtype():
+    """The out stream is budgeted at the FORWARD OUTPUT dtype: a bf16-model
+    answer must not be silently reused for a wider out dtype (review r4 —
+    this path has no compile probe, so the paper arithmetic is the gate)."""
+    from ml_recipe_tpu.ops.flash_attention import _blocked_bwd_cfg
+
+    base = _blocked_bwd_cfg(2048, 12, 64, 2, out_itemsize=2)
+    wide = _blocked_bwd_cfg(2048, 12, 64, 2, out_itemsize=4)
+    assert base is not None
+    # widening out can only shrink the config (never grow it): compare the
+    # (q_blk, hc) lexicographically by VMEM appetite
+    if wide is not None:
+        assert wide[0] * wide[1] <= base[0] * base[1]
+    # default matches the in-dtype assumption
+    assert _blocked_bwd_cfg(2048, 12, 64, 2) == base
