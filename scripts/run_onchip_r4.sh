@@ -32,9 +32,11 @@ run() {  # run <name> <cmd...> — continue past single failures, keep the tail
   fi
 }
 
-run vmem_ceiling      python scripts/measure_vmem_ceiling.py
+# headline first: if the tunnel drops again mid-capture, the most
+# important driver-comparable numbers are already on disk
 run bench_seq512      python bench.py
 run bench_infer       python bench.py --mode infer
+run vmem_ceiling      python scripts/measure_vmem_ceiling.py
 run attn_bwd          python scripts/perf_attn_bwd.py
 run elementwise_floor python scripts/perf_elementwise_floor.py
 
