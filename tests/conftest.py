@@ -36,8 +36,11 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 # the AOT loader logs an E-level pseudo-feature mismatch (+prefer-no-scatter/
 # +prefer-no-gather are XLA-internal, absent from the host prober's list) on
-# every cache hit — same machine, provably executes; silence the native spam
-os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+# every cache hit. Level 2 keeps real native ERRORs visible (level 3 would
+# also hide genuine XLA failures in every inherited subprocess — ADVICE r4
+# #4); the cache-hit spam is E-level too, but it is one line per AOT load
+# and legible, an acceptable price for not flying blind.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
 
 # jax may already be imported (e.g. a sitecustomize tunnel pre-imports it and
 # bakes in JAX_PLATFORMS before this file runs) — override via jax.config,
