@@ -180,6 +180,9 @@ def main(params, model_params) -> None:
 
 
 def cli() -> None:
+    from ..utils.platform import honor_env_platform
+
+    honor_env_platform()
     (parser, model_parser), (params, model_params) = get_params(
         (get_trainer_parser, get_model_parser)
     )

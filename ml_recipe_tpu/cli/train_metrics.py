@@ -76,6 +76,9 @@ def main(params, model_params) -> None:
 
 
 def cli() -> None:
+    from ..utils.platform import honor_env_platform
+
+    honor_env_platform()
     # The reference parsed with the predictor parser only (train_metrics.py:59)
     # yet init_loss/init_datasets read trainer-parser flags (loss, w_*,
     # dummy_dataset, ...) — a latent crash. Route all three parsers and fill

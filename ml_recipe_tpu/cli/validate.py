@@ -47,6 +47,7 @@ def main(params, model_params):
         n_jobs=params.n_jobs,
         buffer_size=params.buffer_size,
         limit=params.limit,
+        fetch_every=getattr(params, "fetch_every", 4),
     )
 
     predictor(val_dataset)
@@ -55,6 +56,9 @@ def main(params, model_params):
 
 
 def cli() -> None:
+    from ..utils.platform import honor_env_platform
+
+    honor_env_platform()
     _, (params, model_params) = get_params((get_predictor_parser, get_model_parser))
     get_logger(logger_name="validate")
 

@@ -116,6 +116,7 @@ def init_model(
         attention_impl=attention_impl,
         remat=getattr(model_params, "remat", False),
         mesh=mesh,  # required by attention_impl='ring' (sequence parallelism)
+        ln_impl=getattr(model_params, "ln_impl", "xla") or "xla",
     )
 
     example = np.zeros((1, 8), dtype=np.int32)

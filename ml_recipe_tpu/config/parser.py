@@ -278,6 +278,13 @@ def get_model_parser() -> ConfigArgumentParser:
     parser.add_argument("--remat", action="store_true",
                         help="Rematerialize encoder layers (jax.checkpoint) to trade "
                              "FLOPs for HBM.")
+    parser.add_argument("--ln_impl", type=cast2(str), default="xla",
+                        choices=[None, "xla", "fused", "auto", "interpret"],
+                        help="LayerNorm implementation: xla (default), fused "
+                             "(one-pass Pallas backward on TPU; falls back to "
+                             "xla off-TPU), auto (fused on TPU when the "
+                             "geometry qualifies), interpret (kernel under "
+                             "pallas interpret mode — tests only).")
 
     return parser
 
@@ -472,6 +479,11 @@ def get_predictor_parser() -> ConfigArgumentParser:
 
     parser.add_argument("--limit", type=cast2(int), default=None,
                         help="Process only specified number of documents.")
+
+    parser.add_argument("--fetch_every", type=int, default=4,
+                        help="Group device->host output fetches over this many "
+                             "completed batches (amortizes per-fetch RTT on "
+                             "tunneled backends; 1 = fetch per batch).")
 
     parser.add_argument("--gpu_compat", action="store_true",
                         help="Accepted for reference-config compatibility.")

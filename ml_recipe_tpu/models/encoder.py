@@ -22,12 +22,41 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from ..ops.attention import dot_product_attention
+from ..ops.layer_norm import layer_norm
 from .config import EncoderConfig
+
+
+class FusedLayerNorm(nn.Module):
+    """Drop-in for ``nn.LayerNorm`` backed by the one-pass Pallas backward
+    (ops/layer_norm.py). Same param names/shapes ('scale'/'bias', [C], f32)
+    so checkpoints are interchangeable between ``ln_impl`` settings."""
+
+    epsilon: float = 1e-12
+    dtype: jnp.dtype = jnp.float32
+    impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x):
+        C = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (C,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (C,), jnp.float32)
+        return layer_norm(x, scale, bias, eps=self.epsilon, dtype=self.dtype,
+                          impl=self.impl)
+
+
+def _ln(cfg: EncoderConfig, dtype, ln_impl: str, name: str):
+    """LayerNorm factory: 'xla' keeps flax's nn.LayerNorm (bit-identical to
+    every recorded baseline); anything else routes through the fused op."""
+    if ln_impl == "xla":
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, name=name, dtype=dtype)
+    return FusedLayerNorm(epsilon=cfg.layer_norm_eps, dtype=dtype,
+                          impl=ln_impl, name=name)
 
 
 class Embeddings(nn.Module):
     cfg: EncoderConfig
     dtype: jnp.dtype = jnp.float32
+    ln_impl: str = "xla"
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids, *, deterministic: bool):
@@ -50,7 +79,7 @@ class Embeddings(nn.Module):
                            dtype=self.dtype)(jnp.zeros_like(token_type_ids))
 
         x = word + pos + typ
-        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="layer_norm", dtype=self.dtype)(x)
+        x = _ln(cfg, self.dtype, self.ln_impl, "layer_norm")(x)
         x = nn.Dropout(cfg.hidden_dropout_prob)(x, deterministic=deterministic)
         return x
 
@@ -60,6 +89,7 @@ class SelfAttention(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attention_impl: str = "xla"
     mesh: Any = None  # required by impl='ring' (sequence parallelism)
+    ln_impl: str = "xla"
 
     @nn.compact
     def __call__(self, hidden, mask, *, deterministic: bool):
@@ -88,13 +118,13 @@ class SelfAttention(nn.Module):
 
         out = nn.Dense(cfg.hidden_size, name="output", dtype=self.dtype)(ctx)
         out = nn.Dropout(cfg.hidden_dropout_prob)(out, deterministic=deterministic)
-        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="layer_norm",
-                            dtype=self.dtype)(hidden + out)
+        return _ln(cfg, self.dtype, self.ln_impl, "layer_norm")(hidden + out)
 
 
 class FeedForward(nn.Module):
     cfg: EncoderConfig
     dtype: jnp.dtype = jnp.float32
+    ln_impl: str = "xla"
 
     @nn.compact
     def __call__(self, hidden, *, deterministic: bool):
@@ -103,8 +133,7 @@ class FeedForward(nn.Module):
         y = nn.gelu(y, approximate=False)
         y = nn.Dense(cfg.hidden_size, name="output", dtype=self.dtype)(y)
         y = nn.Dropout(cfg.hidden_dropout_prob)(y, deterministic=deterministic)
-        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="layer_norm",
-                            dtype=self.dtype)(hidden + y)
+        return _ln(cfg, self.dtype, self.ln_impl, "layer_norm")(hidden + y)
 
 
 class EncoderLayer(nn.Module):
@@ -112,13 +141,14 @@ class EncoderLayer(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attention_impl: str = "xla"
     mesh: Any = None
+    ln_impl: str = "xla"
 
     @nn.compact
     def __call__(self, hidden, mask, deterministic: bool = True):
         hidden = SelfAttention(self.cfg, self.dtype, self.attention_impl,
-                               self.mesh, name="attention")(hidden, mask,
-                               deterministic=deterministic)
-        hidden = FeedForward(self.cfg, self.dtype, name="mlp")(
+                               self.mesh, self.ln_impl, name="attention")(
+                               hidden, mask, deterministic=deterministic)
+        hidden = FeedForward(self.cfg, self.dtype, self.ln_impl, name="mlp")(
             hidden, deterministic=deterministic
         )
         return hidden
@@ -132,6 +162,7 @@ class TransformerEncoder(nn.Module):
     attention_impl: str = "xla"
     remat: bool = False
     mesh: Any = None
+    ln_impl: str = "xla"
 
     @nn.compact
     def __call__(
@@ -148,7 +179,7 @@ class TransformerEncoder(nn.Module):
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
 
-        hidden = Embeddings(cfg, self.dtype, name="embeddings")(
+        hidden = Embeddings(cfg, self.dtype, self.ln_impl, name="embeddings")(
             input_ids, token_type_ids, deterministic=deterministic
         )
 
@@ -158,7 +189,8 @@ class TransformerEncoder(nn.Module):
 
         for i in range(cfg.num_layers):
             hidden = layer_cls(cfg, self.dtype, self.attention_impl, self.mesh,
-                               name=f"layer_{i}")(hidden, attention_mask, deterministic)
+                               self.ln_impl, name=f"layer_{i}")(
+                               hidden, attention_mask, deterministic)
 
         pooled = nn.Dense(cfg.hidden_size, name="pooler", dtype=self.dtype)(hidden[:, 0])
         pooled = jnp.tanh(pooled)

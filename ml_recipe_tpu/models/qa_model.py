@@ -36,6 +36,7 @@ class QAModel(nn.Module):
     attention_impl: str = "xla"
     remat: bool = False
     mesh: Any = None  # required by attention_impl='ring'
+    ln_impl: str = "xla"  # 'fused' = one-pass Pallas LN backward (ops/layer_norm.py)
 
     @nn.compact
     def __call__(
@@ -52,7 +53,7 @@ class QAModel(nn.Module):
 
         sequence_output, pooled_output = TransformerEncoder(
             cfg, self.dtype, self.attention_impl, self.remat, self.mesh,
-            name="transformer"
+            self.ln_impl, name="transformer"
         )(
             input_ids,
             attention_mask=attention_mask,

@@ -1,3 +1,4 @@
 from .attention import dot_product_attention
+from .layer_norm import layer_norm, supports_fused_ln
 
-__all__ = ["dot_product_attention"]
+__all__ = ["dot_product_attention", "layer_norm", "supports_fused_ln"]

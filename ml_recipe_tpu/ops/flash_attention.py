@@ -401,23 +401,32 @@ def _scoped_vmem_ceiling(xla_flags: Optional[str] = None,
        a measurement; on another chip generation re-run the measurement
        script (the compile probe in ``_fused_bwd_hc`` backstops the
        arithmetic either way).
+
+    The result is clamped to >= ``_VMEM_BUDGET`` + 1 MiB: below that the
+    "aggressive" fused-bwd budget would drop under the conservative 12 MB
+    paper budget, inverting the probe's conservative-refuge ordering (and a
+    truncated artifact could yield a zero/negative budget). Ceilings that
+    small are outside this kernel's supported envelope — the compile probe
+    is the gate that actually protects such a chip.
     """
     import json as _json
     import os as _os
     import pathlib as _pathlib
     import re as _re
 
+    floor = _VMEM_BUDGET + 1024 * 1024
     if xla_flags is None:
         xla_flags = _os.environ.get("XLA_FLAGS", "")
     m = _re.search(r"xla_tpu_scoped_vmem_limit_kib=(\d+)", xla_flags)
     if m:
-        return int(m.group(1)) * 1024
+        return max(int(m.group(1)) * 1024, floor)
     art = _pathlib.Path(artifact) if artifact is not None else (
         _pathlib.Path(__file__).resolve().parents[2]
         / "artifacts" / "r4" / "vmem_ceiling.json"
     )
     try:
-        return int(_json.loads(art.read_text())["vmem_ceiling_bytes"])
+        return max(int(_json.loads(art.read_text())["vmem_ceiling_bytes"]),
+                   floor)
     except (OSError, ValueError, KeyError, TypeError):
         # TypeError: {"vmem_ceiling_bytes": null} / a top-level array — any
         # malformed artifact degrades to the default instead of failing the

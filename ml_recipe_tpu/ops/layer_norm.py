@@ -1,0 +1,225 @@
+"""Fused LayerNorm Pallas TPU kernel (forward + one-pass backward).
+
+Attacks the 46 ms/step HBM-bound elementwise segment of the round-2 xplane
+decomposition (BASELINE.md "Elementwise loop fusions (LayerNorm/GELU bwd)",
+6.4% of the bert-base step): XLA differentiates ``nn.LayerNorm`` into a
+row-wise dx loop PLUS separate column reductions for dgamma/dbeta over the
+[B*L, C] arrays, re-reading g and the saved input for each — ~5 full
+activation sweeps of HBM traffic. The fused backward here does ONE pass:
+each grid step reads its [rows, C] block of g and h once, writes dx, and
+accumulates dgamma/dbeta partials into a revisited [1, C] f32 output block
+that stays VMEM-resident across the sequential TPU grid (same idiom as the
+q-blocked attention backward's dk/dv accumulation) — ~3 sweeps total.
+
+Statistics are recomputed in the backward from the saved input (f32 mean /
+rsqrt over C is VPU work on data the kernel already holds; saving forward
+mean/rstd would add an [N, 1] lane-padded residual stream for no HBM win).
+
+The reference runs LayerNorm inside HF BertModel's CUDA kernels
+(SURVEY.md §2.2 "HF BERT CUDA kernels"); this is the TPU-native replacement
+for its fused LN, not a translation.
+
+Like every un-A/B'd perf lever in this repo the op ships OFF by default
+(``ln_impl='xla'``): BASELINE.md records the keep/revert rule and
+``scripts/run_onchip_r4.sh`` stages the on-chip A/B.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .flash_attention import _VMEM_BUDGET
+
+
+def _xla_layer_norm(h, gamma, beta, eps, dtype):
+    """Plain XLA path, flax-equivalent numerics: stats in f32, affine in the
+    compute dtype (mirrors nn.LayerNorm's upcast-for-stats behavior)."""
+    hf = h.astype(jnp.float32)
+    mu = jnp.mean(hf, axis=-1, keepdims=True)
+    xc = hf - mu
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    xhat = xc * jax.lax.rsqrt(var + eps)
+    y = xhat * gamma.astype(jnp.float32) + beta.astype(jnp.float32)
+    return y.astype(dtype)
+
+
+def _rows_block(N: int, C: int, itemsize: int):
+    """Rows per grid step, or ``None`` when no [blk, C] geometry fits VMEM.
+
+    Sized for the BACKWARD (the heavier direction): h and g in-blocks plus
+    the dh out-block, all double-buffered at the activation itemsize, next
+    to ~6 [blk, C] f32 temporaries (h/g upcasts, xhat, g*gamma, dh). The
+    forward reuses the same block size — strictly lighter, so a fit here
+    fits there. blk must divide N exactly (pallas grids don't pad) and be a
+    sublane multiple (8)."""
+    per_row = C * (3 * 2 * itemsize + 6 * 4)
+    best = None
+    for blk in range(8, min(N, 1024) + 1, 8):
+        if N % blk == 0 and per_row * blk <= _VMEM_BUDGET:
+            best = blk
+    return best
+
+
+def supports_fused_ln(N: int, C: int, itemsize: int) -> bool:
+    """True when the fused kernel has a legal geometry for this shape on
+    real TPU hardware: lane-tiled feature dim (C % 128) and a VMEM-feasible
+    row block. Interpret-mode tests may call the op below this gate."""
+    return C % 128 == 0 and _rows_block(N, C, itemsize) is not None
+
+
+def _ln_fwd_kernel(h_ref, gamma_ref, beta_ref, y_ref, *, eps):
+    h = h_ref[...].astype(jnp.float32)                      # [blk, C]
+    mu = jnp.mean(h, axis=1, keepdims=True)
+    xc = h - mu
+    var = jnp.mean(xc * xc, axis=1, keepdims=True)
+    xhat = xc * jax.lax.rsqrt(var + eps)
+    y = xhat * gamma_ref[...].astype(jnp.float32) + beta_ref[...].astype(
+        jnp.float32
+    )
+    y_ref[...] = y.astype(y_ref.dtype)
+
+
+def _ln_bwd_kernel(h_ref, gamma_ref, g_ref, dh_ref, dg_ref, db_ref, *, eps):
+    i = pl.program_id(0)
+    h = h_ref[...].astype(jnp.float32)                      # [blk, C]
+    g = g_ref[...].astype(jnp.float32)
+    gamma = gamma_ref[...].astype(jnp.float32)              # [1, C]
+
+    mu = jnp.mean(h, axis=1, keepdims=True)
+    xc = h - mu
+    var = jnp.mean(xc * xc, axis=1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = xc * rstd
+
+    gg = g * gamma
+    m1 = jnp.mean(gg, axis=1, keepdims=True)
+    m2 = jnp.mean(gg * xhat, axis=1, keepdims=True)
+    dh_ref[...] = ((gg - m1 - xhat * m2) * rstd).astype(dh_ref.dtype)
+
+    # dgamma/dbeta partials accumulate in the revisited [1, C] f32 output
+    # block — resident in VMEM across the sequential grid, written to HBM
+    # once at the end (this is the pass XLA spends two extra activation
+    # sweeps on)
+    pg = jnp.sum(g * xhat, axis=0, keepdims=True)
+    pb = jnp.sum(g, axis=0, keepdims=True)
+
+    @pl.when(i == 0)
+    def _():
+        dg_ref[...] = pg
+        db_ref[...] = pb
+
+    @pl.when(i > 0)
+    def _():
+        dg_ref[...] += pg
+        db_ref[...] += pb
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _fused_ln_flat(h, gamma, beta, eps, out_dtype, interpret):
+    y, _ = _fused_ln_flat_fwd(h, gamma, beta, eps, out_dtype, interpret)
+    return y
+
+
+def _fused_ln_flat_fwd(h, gamma, beta, eps, out_dtype, interpret):
+    N, C = h.shape
+    blk = _rows_block(N, C, h.dtype.itemsize)
+    assert blk is not None, (N, C)  # dispatcher gates on supports_fused_ln
+    y = pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps=eps),
+        grid=(N // blk,),
+        in_specs=[
+            pl.BlockSpec((blk, C), lambda i: (i, 0)),
+            pl.BlockSpec((1, C), lambda i: (0, 0)),
+            pl.BlockSpec((1, C), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((blk, C), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((N, C), out_dtype),
+        interpret=interpret,
+    )(h, gamma[None, :], beta[None, :])
+    return y, (h, gamma)
+
+
+def _fused_ln_flat_bwd(eps, out_dtype, interpret, res, g):
+    h, gamma = res
+    N, C = h.shape
+    blk = _rows_block(N, C, h.dtype.itemsize)
+    dh, dg, db = pl.pallas_call(
+        functools.partial(_ln_bwd_kernel, eps=eps),
+        grid=(N // blk,),
+        in_specs=[
+            pl.BlockSpec((blk, C), lambda i: (i, 0)),
+            pl.BlockSpec((1, C), lambda i: (0, 0)),
+            pl.BlockSpec((blk, C), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((blk, C), lambda i: (i, 0)),
+            pl.BlockSpec((1, C), lambda i: (0, 0)),
+            pl.BlockSpec((1, C), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, C), h.dtype),
+            jax.ShapeDtypeStruct((1, C), jnp.float32),
+            jax.ShapeDtypeStruct((1, C), jnp.float32),
+        ],
+        interpret=interpret,
+    )(h, gamma[None, :], g)
+    return dh, dg[0].astype(gamma.dtype), db[0].astype(gamma.dtype)
+
+
+_fused_ln_flat.defvjp(_fused_ln_flat_fwd, _fused_ln_flat_bwd)
+
+
+def layer_norm(h, gamma, beta, *, eps: float = 1e-12, dtype=jnp.float32,
+               impl: str = "auto"):
+    """LayerNorm over the trailing axis of ``h`` ([..., C]) with f32 stats.
+
+    ``impl``:
+    - 'xla': plain path, any backend;
+    - 'fused': Pallas kernel on TPU; off-TPU falls back to XLA (pallas
+      interpret mode is a correctness vehicle, ~1000x too slow to be a
+      runtime path — a CPU debug run with a TPU config must not crawl);
+    - 'interpret': the kernel under pallas interpret mode on any backend
+      (tests drive the real kernel path on the CPU mesh with this);
+    - 'auto': fused on TPU when the geometry qualifies, else xla."""
+    C = h.shape[-1]
+    N = h.size // C
+    on_tpu = jax.default_backend() == "tpu"
+    if impl == "auto":
+        impl = (
+            "fused"
+            if on_tpu and supports_fused_ln(N, C, h.dtype.itemsize)
+            else "xla"
+        )
+    if impl == "fused" and not on_tpu:
+        logging.getLogger(__name__).info(
+            "ln_impl='fused' on a %s backend: using the XLA path "
+            "(interpret mode is for tests — pass impl='interpret' to force "
+            "the kernel).", jax.default_backend(),
+        )
+        impl = "xla"
+    if impl in ("fused", "interpret"):
+        # 'fused' (real hardware) additionally requires the lane-tiled C
+        # check of supports_fused_ln — a non-128-multiple hidden size must
+        # fall back, not crash in Mosaic; 'interpret' has no lane constraint
+        feasible = (
+            supports_fused_ln(N, C, h.dtype.itemsize)
+            if impl == "fused"
+            else _rows_block(N, C, h.dtype.itemsize) is not None
+        )
+        if not feasible:
+            logging.getLogger(__name__).warning(
+                "fused layer_norm has no feasible kernel geometry for "
+                "N=%d, C=%d; using the XLA path instead.", N, C,
+            )
+        else:
+            y = _fused_ln_flat(
+                h.reshape(N, C), gamma, beta, float(eps),
+                jnp.dtype(dtype), impl == "interpret",
+            )
+            return y.reshape(h.shape)
+    return _xla_layer_norm(h, gamma, beta, eps, dtype)

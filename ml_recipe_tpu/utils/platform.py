@@ -1,0 +1,33 @@
+"""Platform-selection self-defense for entry points.
+
+A host-side launcher (sitecustomize) may pre-import jax and pin
+``jax_platforms`` at the CONFIG level before any of our code runs — an env
+``JAX_PLATFORMS=cpu`` is then silently ignored (config beats env) and a CPU
+debug run dials the hardware backend instead, which on a downed tunnel is
+an indefinite hang, not an error. bench.py has carried this guard since
+round 4; the CLI entry points route through here so a shell-level
+``JAX_PLATFORMS=cpu python -m ml_recipe_tpu.cli.train ...`` behaves the
+same as the documented in-process recipe.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_env_platform() -> None:
+    """Re-assert the ``JAX_PLATFORMS`` env var at the jax-config level.
+
+    No-op when the env var is unset or a backend is already initialized
+    (too late to change — jax raises, and the raise is swallowed because
+    the entry point is already running on that backend by choice).
+    """
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if not env_platforms:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", env_platforms)
+    except Exception:  # pragma: no cover - backend already initialized
+        pass

@@ -35,7 +35,25 @@ run() {  # run <name> <cmd...> — continue past single failures, keep the tail
 # headline first: if the tunnel drops again mid-capture, the most
 # important driver-comparable numbers are already on disk
 run bench_seq512      python bench.py
+
+# A/B: fused one-pass LayerNorm backward (ops/layer_norm.py), IMMEDIATELY
+# after the baseline so both runs share the same _VMEM_CEILING provenance
+# (capturing vmem_ceiling.json between them would change the attention
+# backward's head-chunk pick and confound the LN delta). Keep rule
+# (BASELINE.md): flip the default to 'auto' only if this beats bench_seq512
+# by >1% on window medians; revert the lever if it measures negative.
+run bench_seq512_lnfused python bench.py --ln_impl fused
+
 run bench_infer       python bench.py --mode infer
+# A/B: grouped output fetching (VERDICT r4 weak #3) — sweep without source
+# edits now that --fetch_every is plumbed. bench_infer above runs the
+# shipped default (4).
+run bench_infer_fetch1 python bench.py --mode infer --fetch_every 1
+run bench_infer_fetch8 python bench.py --mode infer --fetch_every 8
+
+# vmem_ceiling AFTER the A/B pairs: the artifact feeds _VMEM_CEILING on the
+# next import, so capturing it mid-sequence would split the bench runs
+# across two budget regimes
 run vmem_ceiling      python scripts/measure_vmem_ceiling.py
 run attn_bwd          python scripts/perf_attn_bwd.py
 run elementwise_floor python scripts/perf_elementwise_floor.py

@@ -650,15 +650,24 @@ def test_scoped_vmem_ceiling_resolution_order(tmp_path):
 
     # 1. explicit flag wins over everything
     assert _scoped_vmem_ceiling(
-        xla_flags="--foo --xla_tpu_scoped_vmem_limit_kib=8192",
+        xla_flags="--foo --xla_tpu_scoped_vmem_limit_kib=15000",
         artifact=str(art),
-    ) == 8192 * 1024
+    ) == 15000 * 1024
     # 2. measured artifact beats the default
     assert _scoped_vmem_ceiling(xla_flags="", artifact=str(art)) == 14680064
     # 3. documented default when neither exists
     assert _scoped_vmem_ceiling(
         xla_flags="", artifact=str(tmp_path / "missing.json")
     ) == 16 * 1024 * 1024
+    # tiny flag/artifact values clamp to the 13 MiB floor: below it the
+    # aggressive budget would undercut the conservative refuge (review r5)
+    floor = 13 * 1024 * 1024
+    assert _scoped_vmem_ceiling(
+        xla_flags="--xla_tpu_scoped_vmem_limit_kib=8192", artifact=None
+    ) == floor
+    tiny = tmp_path / "tiny.json"
+    tiny.write_text('{"vmem_ceiling_bytes": 1048576}')
+    assert _scoped_vmem_ceiling(xla_flags="", artifact=str(tiny)) == floor
     # malformed artifacts degrade to the default, not a crash (this runs at
     # module import: a crash here would take the whole package down)
     for content in ("{not json", '{"vmem_ceiling_bytes": null}', "[1, 2]",
