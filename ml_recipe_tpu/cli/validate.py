@@ -47,7 +47,7 @@ def main(params, model_params):
         n_jobs=params.n_jobs,
         buffer_size=params.buffer_size,
         limit=params.limit,
-        fetch_every=getattr(params, "fetch_every", 4),
+        fetch_every=params.fetch_every,
     )
 
     predictor(val_dataset)

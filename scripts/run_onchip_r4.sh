@@ -67,4 +67,15 @@ if [ "${1:-full}" != "quick" ]; then
                         --infer_doc_len 3000 --infer_jobs 16 --doc_stride 256
 fi
 
+# Suite-hygiene insurance (VERDICT r4 #8): print the slow-tier timing AND
+# its pass/fail summary so a regression past the 10-minute line is visible
+# in every capture log (the tier runs on the CPU mesh regardless of the
+# chip; the pipeline's status is tail's, so a red tier cannot eat the
+# capture that just succeeded above).
+if [ "${1:-full}" != "quick" ]; then
+  echo "=== slow-tier timing (keep under 10 min)" >&2
+  ( time JAX_PLATFORMS=cpu python -m pytest tests/ -m slow -q ) 2>&1 \
+    | tail -6 >&2
+fi
+
 echo "=== capture complete; artifacts in artifacts/r4/" >&2
