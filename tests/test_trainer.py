@@ -62,7 +62,8 @@ class TP:
 
 def _make_trainer(tmp_path, *, batch_split=1, n_epochs=1, debug=False,
                   train_len=32, test_len=10, dropout=0.1, tp_cls=TP,
-                  mesh_spec="data:8", attention_impl="xla", **trainer_extra):
+                  mesh_spec="data:8", attention_impl="xla", ln_impl="xla",
+                  **trainer_extra):
     tokenizer = make_tokenizer(tmp_path)
     rng = np.random.default_rng(0)
     train_ds = DummyDataset(
@@ -80,7 +81,8 @@ def _make_trainer(tmp_path, *, batch_split=1, n_epochs=1, debug=False,
         hidden_dropout_prob=dropout, attention_probs_dropout_prob=dropout,
     )
     mesh = build_mesh(mesh_spec)
-    model = QAModel(cfg, attention_impl=attention_impl, mesh=mesh)
+    model = QAModel(cfg, attention_impl=attention_impl, mesh=mesh,
+                    ln_impl=ln_impl)
     sample = train_ds[0]
     # init through the XLA-attention twin: params are impl-independent, and
     # ring's shard_map cannot shard the [1, L] init batch over the data axis
