@@ -119,17 +119,13 @@ def _ln_bwd_kernel(h_ref, gamma_ref, g_ref, dh_ref, dg_ref, db_ref, *, eps):
         db_ref[...] += pb
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _fused_ln_flat(h, gamma, beta, eps, out_dtype, interpret):
-    y, _ = _fused_ln_flat_fwd(h, gamma, beta, eps, out_dtype, interpret)
-    return y
-
-
-def _fused_ln_flat_fwd(h, gamma, beta, eps, out_dtype, interpret):
-    N, C = h.shape
-    blk = _rows_block(N, C, h.dtype.itemsize)
-    assert blk is not None, (N, C)  # dispatcher gates on supports_fused_ln
-    y = pl.pallas_call(
+def _build_ln_fwd_call(N, C, blk, eps, in_dtype, out_dtype, interpret):
+    """The forward ``pallas_call`` for one geometry, shared by the real
+    execution path and the compile probe so they cannot drift (same
+    discipline as the attention ``_build_fused_bwd_call``). Takes
+    ``(h [N, C], gamma [1, C], beta [1, C])``."""
+    del in_dtype  # the argument arrays carry it; kept for probe symmetry
+    return pl.pallas_call(
         functools.partial(_ln_fwd_kernel, eps=eps),
         grid=(N // blk,),
         in_specs=[
@@ -140,15 +136,13 @@ def _fused_ln_flat_fwd(h, gamma, beta, eps, out_dtype, interpret):
         out_specs=pl.BlockSpec((blk, C), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((N, C), out_dtype),
         interpret=interpret,
-    )(h, gamma[None, :], beta[None, :])
-    return y, (h, gamma)
+    )
 
 
-def _fused_ln_flat_bwd(eps, out_dtype, interpret, res, g):
-    h, gamma = res
-    N, C = h.shape
-    blk = _rows_block(N, C, h.dtype.itemsize)
-    dh, dg, db = pl.pallas_call(
+def _build_ln_bwd_call(N, C, blk, eps, in_dtype, interpret):
+    """The backward ``pallas_call`` for one geometry (probe-shared). Takes
+    ``(h [N, C], gamma [1, C], g [N, C])`` and returns (dh, dgamma, dbeta)."""
+    return pl.pallas_call(
         functools.partial(_ln_bwd_kernel, eps=eps),
         grid=(N // blk,),
         in_specs=[
@@ -162,12 +156,77 @@ def _fused_ln_flat_bwd(eps, out_dtype, interpret, res, g):
             pl.BlockSpec((1, C), lambda i: (0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((N, C), h.dtype),
+            jax.ShapeDtypeStruct((N, C), in_dtype),
             jax.ShapeDtypeStruct((1, C), jnp.float32),
             jax.ShapeDtypeStruct((1, C), jnp.float32),
         ],
         interpret=interpret,
-    )(h, gamma[None, :], g)
+    )
+
+
+_ln_probe_results: dict = {}
+
+
+def _fused_ln_compiles(blk, C, in_dtype, out_dtype, param_dtype, eps) -> bool:
+    """Cached Mosaic compile probe for BOTH kernel directions at one block
+    geometry (N = blk, one grid step — scoped VMEM is grid-size-independent,
+    so one verdict covers every N sharing the block). The LN kernel has no
+    tunable knob to walk down, so a rejection routes the caller to the XLA
+    path instead of crashing the training step at trace time; this is the
+    safety net that makes ``--ln_impl fused`` runnable on a chip generation
+    the kernel has never met (the attention kernels' probe discipline).
+
+    ``param_dtype`` is gamma/beta's dtype — probed (and keyed) at the real
+    value so a non-f32 affine param cannot pass the probe with one dtype
+    and execute with another."""
+    key = (blk, C, str(in_dtype), str(out_dtype), str(param_dtype))
+    ok = _ln_probe_results.get(key)
+    if ok is None:
+        h_s = jax.ShapeDtypeStruct((blk, C), in_dtype)
+        vec = jax.ShapeDtypeStruct((1, C), param_dtype)
+        g_s = jax.ShapeDtypeStruct((blk, C), out_dtype)
+        try:
+            fwd = _build_ln_fwd_call(blk, C, blk, eps, in_dtype, out_dtype,
+                                     interpret=False)
+            jax.jit(fwd).lower(h_s, vec, vec).compile()
+            bwd = _build_ln_bwd_call(blk, C, blk, eps, in_dtype,
+                                     interpret=False)
+            jax.jit(bwd).lower(h_s, vec, g_s).compile()
+            ok = True
+        except Exception as e:  # noqa: BLE001 - any rejection means fallback
+            logging.getLogger(__name__).warning(
+                "fused layer_norm kernel did not compile at blk=%d, C=%d "
+                "(%s -> %s); using the XLA path. Error: %s",
+                blk, C, in_dtype, out_dtype, e,
+            )
+            ok = False
+        _ln_probe_results[key] = ok
+    return ok
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _fused_ln_flat(h, gamma, beta, eps, out_dtype, interpret):
+    y, _ = _fused_ln_flat_fwd(h, gamma, beta, eps, out_dtype, interpret)
+    return y
+
+
+def _fused_ln_flat_fwd(h, gamma, beta, eps, out_dtype, interpret):
+    N, C = h.shape
+    blk = _rows_block(N, C, h.dtype.itemsize)
+    assert blk is not None, (N, C)  # dispatcher gates on supports_fused_ln
+    y = _build_ln_fwd_call(N, C, blk, eps, h.dtype, out_dtype, interpret)(
+        h, gamma[None, :], beta[None, :]
+    )
+    return y, (h, gamma)
+
+
+def _fused_ln_flat_bwd(eps, out_dtype, interpret, res, g):
+    h, gamma = res
+    N, C = h.shape
+    blk = _rows_block(N, C, h.dtype.itemsize)
+    dh, dg, db = _build_ln_bwd_call(N, C, blk, eps, h.dtype, interpret)(
+        h, gamma[None, :], g
+    )
     return dh, dg[0].astype(gamma.dtype), db[0].astype(gamma.dtype)
 
 
@@ -203,19 +262,22 @@ def layer_norm(h, gamma, beta, *, eps: float = 1e-12, dtype=jnp.float32,
         )
         impl = "xla"
     if impl in ("fused", "interpret"):
-        # 'fused' (real hardware) additionally requires the lane-tiled C
-        # check of supports_fused_ln — a non-128-multiple hidden size must
-        # fall back, not crash in Mosaic; 'interpret' has no lane constraint
-        feasible = (
-            supports_fused_ln(N, C, h.dtype.itemsize)
-            if impl == "fused"
-            else _rows_block(N, C, h.dtype.itemsize) is not None
-        )
-        if not feasible:
+        blk = _rows_block(N, C, h.dtype.itemsize)
+        # 'fused' (real hardware) additionally requires a lane-tiled C and
+        # a passing Mosaic compile probe — a rejected geometry must fall
+        # back, not crash the training step at trace time; 'interpret' has
+        # neither constraint
+        geometry_ok = blk is not None and (impl == "interpret"
+                                           or C % 128 == 0)
+        if not geometry_ok:
             logging.getLogger(__name__).warning(
                 "fused layer_norm has no feasible kernel geometry for "
                 "N=%d, C=%d; using the XLA path instead.", N, C,
             )
+        elif impl == "fused" and not _fused_ln_compiles(
+            blk, C, h.dtype, jnp.dtype(dtype), gamma.dtype, float(eps)
+        ):
+            pass  # the probe already warned with the compile error
         else:
             y = _fused_ln_flat(
                 h.reshape(N, C), gamma, beta, float(eps),

@@ -185,6 +185,47 @@ def test_layer_norm_3d_shape_roundtrip():
                                np.asarray(y2))
 
 
+def test_fused_ln_compile_probe_falls_back_and_caches(monkeypatch):
+    """On a 'TPU' whose Mosaic rejects the kernel (emulated here: a CPU
+    host cannot compile a non-interpret pallas_call at all), impl='fused'
+    must WARN and produce the XLA result rather than crash the training
+    step at trace time — and the probe verdict must be cached so the
+    fallback costs one compile attempt per geometry, not one per call."""
+    import importlib
+
+    # ops/__init__ re-exports the layer_norm FUNCTION under the package
+    # attribute, shadowing the submodule name — resolve the module itself
+    lnmod = importlib.import_module("ml_recipe_tpu.ops.layer_norm")
+
+    monkeypatch.setattr(lnmod.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(lnmod, "_ln_probe_results", {})
+
+    probes = []
+    real_fwd_builder = lnmod._build_ln_fwd_call
+
+    def counting_fwd_builder(*args, **kwargs):
+        probes.append(args)
+        return real_fwd_builder(*args, **kwargs)
+
+    monkeypatch.setattr(lnmod, "_build_ln_fwd_call", counting_fwd_builder)
+
+    h, gamma, beta = _data(N=64, C=128)
+    y = lnmod.layer_norm(h, gamma, beta, eps=1e-12, dtype=jnp.float32,
+                         impl="fused")
+    ref = lnmod._xla_layer_norm(h, gamma, beta, 1e-12, jnp.float32)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(ref))
+    assert lnmod._ln_probe_results == {
+        (64, 128, "float32", "float32", "float32"): False
+    }
+    assert len(probes) == 1
+
+    # second call: cached verdict, no new compile attempt
+    y2 = lnmod.layer_norm(h, gamma, beta, eps=1e-12, dtype=jnp.float32,
+                          impl="fused")
+    np.testing.assert_array_equal(np.asarray(y2), np.asarray(ref))
+    assert len(probes) == 1
+
+
 def test_fused_ln_training_trajectory_matches_xla(tmp_path):
     """The custom VJP composed with the REAL trainer (grad-accum scan, psum,
     clip, AdamW, schedule): a short training run with the kernel at every LN
