@@ -65,11 +65,19 @@ def _rows_block(N: int, C: int, itemsize: int):
     return best
 
 
+def _fused_geometry(N: int, C: int, itemsize: int):
+    """The row block for a REAL-hardware fused execution, or ``None`` when
+    none is legal: lane-tiled feature dim (C % 128) and a VMEM-feasible row
+    block. The single feasibility rule consulted by both the 'auto' gate
+    and the explicit 'fused' dispatch (they must not be able to disagree);
+    interpret-mode tests may call the op below this gate."""
+    if C % 128 != 0:
+        return None
+    return _rows_block(N, C, itemsize)
+
+
 def supports_fused_ln(N: int, C: int, itemsize: int) -> bool:
-    """True when the fused kernel has a legal geometry for this shape on
-    real TPU hardware: lane-tiled feature dim (C % 128) and a VMEM-feasible
-    row block. Interpret-mode tests may call the op below this gate."""
-    return C % 128 == 0 and _rows_block(N, C, itemsize) is not None
+    return _fused_geometry(N, C, itemsize) is not None
 
 
 def _ln_fwd_kernel(h_ref, gamma_ref, beta_ref, y_ref, *, eps):
@@ -262,14 +270,16 @@ def layer_norm(h, gamma, beta, *, eps: float = 1e-12, dtype=jnp.float32,
         )
         impl = "xla"
     if impl in ("fused", "interpret"):
-        blk = _rows_block(N, C, h.dtype.itemsize)
-        # 'fused' (real hardware) additionally requires a lane-tiled C and
-        # a passing Mosaic compile probe — a rejected geometry must fall
-        # back, not crash the training step at trace time; 'interpret' has
-        # neither constraint
-        geometry_ok = blk is not None and (impl == "interpret"
-                                           or C % 128 == 0)
-        if not geometry_ok:
+        # 'fused' (real hardware) requires the lane-tiled geometry rule of
+        # _fused_geometry and a passing Mosaic compile probe — a rejected
+        # geometry must fall back, not crash the training step at trace
+        # time; 'interpret' needs only a row block
+        blk = (
+            _fused_geometry(N, C, h.dtype.itemsize)
+            if impl == "fused"
+            else _rows_block(N, C, h.dtype.itemsize)
+        )
+        if blk is None:
             logging.getLogger(__name__).warning(
                 "fused layer_norm has no feasible kernel geometry for "
                 "N=%d, C=%d; using the XLA path instead.", N, C,

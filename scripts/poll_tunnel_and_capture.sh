@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Poll the TPU tunnel; run the staged on-chip capture at first availability.
+#
+#   bash scripts/poll_tunnel_and_capture.sh [interval_s] [quick]
+#
+# VERDICT r4 #1 asked for tunnel availability to be treated as a first-class
+# event: the backend was down for the whole of rounds 3 and 4, and the staged
+# measurements (scripts/run_onchip_r4.sh) have never met a live chip. This
+# watcher probes cheaply (a bounded jax.devices() dial — the tunnel's outage
+# mode is an indefinite HANG, so the probe must be killed from outside) and
+# fires the capture exactly once when the dial succeeds.
+set -u
+cd "$(dirname "$0")/.."
+
+INTERVAL="${1:-420}"
+MODE="${2:-full}"
+
+probe() {
+  # rc 0 = a real TPU answered; anything else (error, hang-kill) = down.
+  timeout 90 python - <<'EOF'
+import sys
+import jax
+ds = jax.devices()
+sys.exit(0 if ds and ds[0].platform == "tpu" else 1)
+EOF
+}
+
+echo "[poll] probing every ${INTERVAL}s; capture mode: ${MODE}" >&2
+while true; do
+  if probe >/dev/null 2>&1; then
+    echo "[poll] tunnel is UP — starting capture" >&2
+    if bash scripts/run_onchip_r4.sh "$MODE"; then
+      echo "[poll] capture finished; artifacts in artifacts/r4/ (check the" \
+           "per-measurement .failed/.log files — the runbook continues past" \
+           "single failures by design)" >&2
+      exit 0
+    fi
+    # the capture script itself aborted (chip dropped mid-run, interpreter
+    # missing, ...): do not consume the rare tunnel-up window on a
+    # misreported success — resume polling and retry
+    echo "[poll] capture FAILED (rc=$?) — resuming polling" >&2
+  fi
+  echo "[poll] $(date -u +%H:%M:%S) tunnel down" >&2
+  sleep "$INTERVAL"
+done
