@@ -175,7 +175,8 @@ def _build_ln_bwd_call(N, C, blk, eps, in_dtype, interpret):
 _ln_probe_results: dict = {}
 
 
-def _fused_ln_compiles(blk, C, in_dtype, out_dtype, param_dtype, eps) -> bool:
+def _fused_ln_compiles(blk, C, in_dtype, out_dtype, gamma_dtype, beta_dtype,
+                       eps) -> bool:
     """Cached Mosaic compile probe for BOTH kernel directions at one block
     geometry (N = blk, one grid step — scoped VMEM is grid-size-independent,
     so one verdict covers every N sharing the block). The LN kernel has no
@@ -184,22 +185,24 @@ def _fused_ln_compiles(blk, C, in_dtype, out_dtype, param_dtype, eps) -> bool:
     safety net that makes ``--ln_impl fused`` runnable on a chip generation
     the kernel has never met (the attention kernels' probe discipline).
 
-    ``param_dtype`` is gamma/beta's dtype — probed (and keyed) at the real
-    value so a non-f32 affine param cannot pass the probe with one dtype
-    and execute with another."""
-    key = (blk, C, str(in_dtype), str(out_dtype), str(param_dtype))
+    ``gamma_dtype``/``beta_dtype`` are the affine params' dtypes — probed
+    (and keyed) INDIVIDUALLY at their real values so no argument can pass
+    the probe with one dtype and execute with another."""
+    key = (blk, C, str(in_dtype), str(out_dtype), str(gamma_dtype),
+           str(beta_dtype))
     ok = _ln_probe_results.get(key)
     if ok is None:
         h_s = jax.ShapeDtypeStruct((blk, C), in_dtype)
-        vec = jax.ShapeDtypeStruct((1, C), param_dtype)
+        gamma_s = jax.ShapeDtypeStruct((1, C), gamma_dtype)
+        beta_s = jax.ShapeDtypeStruct((1, C), beta_dtype)
         g_s = jax.ShapeDtypeStruct((blk, C), out_dtype)
         try:
             fwd = _build_ln_fwd_call(blk, C, blk, eps, in_dtype, out_dtype,
                                      interpret=False)
-            jax.jit(fwd).lower(h_s, vec, vec).compile()
+            jax.jit(fwd).lower(h_s, gamma_s, beta_s).compile()
             bwd = _build_ln_bwd_call(blk, C, blk, eps, in_dtype,
                                      interpret=False)
-            jax.jit(bwd).lower(h_s, vec, g_s).compile()
+            jax.jit(bwd).lower(h_s, gamma_s, g_s).compile()
             ok = True
         except Exception as e:  # noqa: BLE001 - any rejection means fallback
             logging.getLogger(__name__).warning(
@@ -285,7 +288,8 @@ def layer_norm(h, gamma, beta, *, eps: float = 1e-12, dtype=jnp.float32,
                 "N=%d, C=%d; using the XLA path instead.", N, C,
             )
         elif impl == "fused" and not _fused_ln_compiles(
-            blk, C, h.dtype, jnp.dtype(dtype), gamma.dtype, float(eps)
+            blk, C, h.dtype, jnp.dtype(dtype), gamma.dtype, beta.dtype,
+            float(eps)
         ):
             pass  # the probe already warned with the compile error
         else:

@@ -34,11 +34,13 @@ while true; do
            "per-measurement .failed/.log files — the runbook continues past" \
            "single failures by design)" >&2
       exit 0
+    else
+      # the capture script itself aborted (chip dropped mid-run,
+      # interpreter missing, ...): do not consume the rare tunnel-up
+      # window on a misreported success — resume polling and retry
+      rc=$?
+      echo "[poll] capture FAILED (rc=$rc) — resuming polling" >&2
     fi
-    # the capture script itself aborted (chip dropped mid-run, interpreter
-    # missing, ...): do not consume the rare tunnel-up window on a
-    # misreported success — resume polling and retry
-    echo "[poll] capture FAILED (rc=$?) — resuming polling" >&2
   fi
   echo "[poll] $(date -u +%H:%M:%S) tunnel down" >&2
   sleep "$INTERVAL"

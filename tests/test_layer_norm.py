@@ -132,6 +132,43 @@ def test_multi_block_accumulation_equals_single_block():
     _close(g_f[1], g_r[1], "dbeta")
 
 
+@pytest.mark.parametrize(
+    "N,C,dtype",
+    [
+        (8, 32, jnp.float32),      # smallest legal block, tiny C
+        (24, 96, jnp.float32),     # non-power-of-two N and C
+        (160, 256, jnp.bfloat16),  # bf16 activations, N % blk candidates
+        (1024, 384, jnp.float32),  # C = 3*128, larger N
+    ],
+)
+def test_vjp_matches_autodiff_across_geometries(N, C, dtype):
+    """Geometry sweep: the kernel VJP must agree with XLA autodiff at
+    block-edge shapes (odd divisor structures, non-power-of-two C, bf16),
+    not just the bert-like shapes the main tests use."""
+    h, gamma, beta = _data(N=N, C=C, dtype=dtype, seed=3)
+    f32 = dtype == jnp.float32
+
+    def fused_loss(h, gamma, beta):
+        y = _fused_ln_flat(h, gamma, beta, 1e-9, jnp.dtype(dtype), True)
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    def ref_loss(h, gamma, beta):
+        y = _xla_layer_norm(h, gamma, beta, 1e-9, dtype)
+        return jnp.sum(y.astype(jnp.float32) ** 2)
+
+    g_f = jax.grad(fused_loss, argnums=(0, 1, 2))(h, gamma, beta)
+    g_r = jax.grad(ref_loss, argnums=(0, 1, 2))(h, gamma, beta)
+    for a, b, name in zip(g_f, g_r, ("dh", "dgamma", "dbeta")):
+        if f32:
+            _close(a, b, name)
+        else:
+            np.testing.assert_allclose(
+                np.asarray(a, dtype=np.float32),
+                np.asarray(b, dtype=np.float32),
+                rtol=3e-2, atol=3e-2, err_msg=name,
+            )
+
+
 def test_rows_block_vmem_arithmetic():
     from ml_recipe_tpu.ops.flash_attention import _VMEM_BUDGET
 
@@ -215,7 +252,7 @@ def test_fused_ln_compile_probe_falls_back_and_caches(monkeypatch):
     ref = lnmod._xla_layer_norm(h, gamma, beta, 1e-12, jnp.float32)
     np.testing.assert_array_equal(np.asarray(y), np.asarray(ref))
     assert lnmod._ln_probe_results == {
-        (64, 128, "float32", "float32", "float32"): False
+        (64, 128, "float32", "float32", "float32", "float32"): False
     }
     assert len(probes) == 1
 
