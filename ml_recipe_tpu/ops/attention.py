@@ -104,6 +104,7 @@ def dot_product_attention(
         from .flash_attention import (
             supports_blocked_bwd, supports_blocked_fwd, supports_fused_bwd,
         )
+        from .flash_streaming import supports_streaming
 
         L, H, D = q.shape[1], q.shape[2], q.shape[3]
         in_isz = jnp.dtype(q.dtype).itemsize
@@ -117,25 +118,39 @@ def dot_product_attention(
             or supports_blocked_bwd(L, H, D, in_isz, dropout_rate,
                                     out_itemsize=out_isz)
         )
-        shapes_ok = supports_fused_bwd(L) or blocked_ok
+        resident_ok = supports_fused_bwd(L) or blocked_ok
+        # The streaming-KV regime serves lengths the resident-KV kernels
+        # decline (~>2k). The proven regimes keep priority where they
+        # apply — their on-chip numbers are recorded; streaming replaces
+        # only the XLA fallback.
+        streaming_ok = not resident_ok and supports_streaming(
+            L, H, D, in_isz, out_isz, dropout_rate
+        )
+        shapes_ok = resident_ok or streaming_ok
 
     if impl == "auto":
         use_pallas = jax.default_backend() == "tpu" and shapes_ok
         impl = "pallas" if use_pallas else "xla"
 
     if impl == "pallas":
-        from .flash_attention import flash_attention
-
         if not shapes_ok:
             import logging
 
             logging.getLogger(__name__).warning(
-                f"Pallas fused attention has no VMEM-feasible kernel config "
+                f"Pallas attention has no VMEM-feasible kernel config "
                 f"for L={L}, H={H}, D={D}, rate={dropout_rate}; using XLA "
                 f"attention instead."
             )
         else:
             seed = _dropout_seed(dropout_rng) if dropout_rate > 0.0 else None
+            if streaming_ok:
+                from .flash_streaming import streaming_attention
+
+                return streaming_attention(
+                    q, k, v, mask, seed=seed, dtype=dtype, rate=dropout_rate
+                )
+            from .flash_attention import flash_attention
+
             return flash_attention(
                 q, k, v, mask, seed=seed, dtype=dtype, rate=dropout_rate
             )

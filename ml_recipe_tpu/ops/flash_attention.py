@@ -57,18 +57,23 @@ _NEG_INF = -1e30
 _FUSED_BWD_MAX_LEN = 512
 
 
-def _uniform_grid(seed, bh, L: int, rows: Optional[int] = None, row_offset=0):
-    """[rows, L] uniform floats in [0, 1) from a murmur3-finalizer hash of
-    (seed, batch*heads+head, flat index). Plain int32 vector ops only.
-    ``rows``/``row_offset`` select a q-block slice of the full [L, L] grid:
-    the bits depend only on the ABSOLUTE row index, so the q-blocked kernels
-    regenerate exactly the mask the fused kernels would (and the backward
-    regenerates the forward's regardless of either side's block size)."""
+def _uniform_grid(seed, bh, L: int, rows: Optional[int] = None, row_offset=0,
+                  cols: Optional[int] = None, col_offset=0):
+    """[rows, cols] uniform floats in [0, 1) from a murmur3-finalizer hash
+    of (seed, batch*heads+head, flat index). Plain int32 vector ops only.
+    ``rows``/``row_offset`` (and ``cols``/``col_offset``) select a tile of
+    the full [L, L] grid: the bits depend only on the ABSOLUTE (row, col)
+    indices flattened against the TRUE row length ``L``, so every kernel
+    regime — fused, q-blocked, and the streaming (q, k)-tiled one —
+    regenerates exactly the same mask for the same sequence (and each
+    backward regenerates its forward's regardless of block sizes)."""
     if rows is None:
         rows = L
-    r = jax.lax.broadcasted_iota(jnp.int32, (rows, L), 0) + row_offset
-    cols = jax.lax.broadcasted_iota(jnp.int32, (rows, L), 1)
-    x = r * jnp.int32(L) + cols
+    if cols is None:
+        cols = L
+    r = jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 0) + row_offset
+    c = jax.lax.broadcasted_iota(jnp.int32, (rows, cols), 1) + col_offset
+    x = r * jnp.int32(L) + c
     x = x ^ (seed + bh * jnp.int32(-1640531527))  # 2654435761 as int32
     return hash_uniform(x)
 

@@ -1,0 +1,409 @@
+"""Streaming-KV flash attention: the long-sequence regime beyond ~2k.
+
+The q-blocked kernels (ops/flash_attention.py) keep each head-group's WHOLE
+K/V resident in VMEM, which caps them at L ~= 2048 for bf16/D=64 — beyond
+that the dispatcher fell back to XLA attention, which materializes the
+[B, H, L, L] score tensor in HBM (805 MB per bert-base head-set at L=4096).
+This module removes that single-chip ceiling with the classic
+FlashAttention-2 tiling: K/V stream through VMEM in blocks, the forward
+keeps an online-softmax state (running max / denominator / output
+accumulator) in VMEM scratch across the k sweep, and the backward splits
+into a dq kernel (k innermost, dq accumulated in f32 scratch) and a dk/dv
+kernel (q innermost, dk/dv accumulated in f32 scratch) — the [L, L] tensor
+never exists in HBM in either direction, and per-program VMEM is O(blk^2),
+independent of L.
+
+Everything that made the resident-KV kernels correct is reused unchanged:
+the folded [B, L, H*D] layout (no relayout copies), per-batch-row seed
+prefetch, the forward-saved per-row logsumexp (probabilities recomputed as
+one ``exp(s - lse)``), the FlashAttention-2 delta identity for the softmax
+row term (``row_i = g_i . out_i``), and the murmur3-hash dropout keyed by
+ABSOLUTE (row, col) indices — so a streaming backward regenerates the
+streaming forward's exact mask, and the mask for a given (seed, L) is
+bit-identical to what the fused/q-blocked kernels would draw.
+
+Replaces the long-context portion of the reference's HF BERT CUDA
+attention (SURVEY.md §2.2); the reference itself has no >2k story at all —
+its max_seq_len is 512 (config/test_bert.cfg:66).
+
+Dispatcher position (ops/attention.py): AFTER the proven fused/q-blocked
+regimes (whose on-chip numbers are recorded), BEFORE the XLA fallback —
+it only activates where XLA was the previous answer, so it is pure upside;
+the on-chip A/B is staged in the runbook like every other unproven lever.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import (
+    _NEG_INF,
+    _VMEM_BUDGET,
+    _fold,
+    _legal_head_chunks,
+    _row_seeds,
+    _uniform_grid,
+)
+
+
+def _pick_stream_block(L: int):
+    for blk in (512, 256, 128):
+        if L % blk == 0 and L // blk >= 2:
+            return blk
+    return None
+
+
+def streaming_cfg(L: int, H: int, D: int, in_itemsize: int,
+                  out_itemsize: int, rate: float = 0.0):
+    """(blk, hc) for the streaming kernels, or ``None``.
+
+    Working set per program (the dk/dv kernel is the heaviest): f32
+    [blk, blk] tiles — p, dp, ds + one of deliberate margin (+ the dropout
+    uniform tile when ``rate > 0``; no compile probe here, so the paper
+    arithmetic must not run the budget to the wire); per-stream blocks of
+    hc*D lanes double-buffered at their own itemsizes (q, k, v, g, out in;
+    dk, dv out) plus the lane-padded [1, hc, blk, 1] lse block; f32
+    accumulator scratch (2 x [blk, hc*D] in the dk/dv kernel, 1 + the
+    [hc, blk, 1] m/l pair in the forward — scratch is not double-buffered).
+    """
+    blk = _pick_stream_block(L)
+    if blk is None:
+        return None
+    n_tiles = 4 + (1 if rate > 0.0 else 0)
+    tile_bytes = n_tiles * blk * blk * 4
+    for hc in sorted(_legal_head_chunks(H, D), reverse=True):
+        lanes = hc * D
+        # every stream at ITS OWN itemsize (the discipline the blocked-bwd
+        # cfg learned in round 4): q/k/v/g in-blocks and the dq|dk+dv
+        # out-blocks carry the INPUT dtype; the saved-out residual
+        # in-block carries the forward-OUTPUT dtype
+        block_bytes = (
+            2 * blk * lanes * (4 + 2) * in_itemsize  # q k v g + dk,dv
+            + 2 * blk * lanes * out_itemsize         # out residual
+            + hc * 2 * blk * 128 * 4                 # lse block, lane-padded
+        )
+        scratch_bytes = 2 * blk * lanes * 4 + 2 * hc * blk * 128 * 4
+        if block_bytes + scratch_bytes + tile_bytes <= _VMEM_BUDGET:
+            return blk, hc
+    return None
+
+
+def supports_streaming(L: int, H: int, D: int, in_itemsize: int,
+                       out_itemsize: int, rate: float = 0.0) -> bool:
+    """True when the streaming regime applies: a legal block geometry that
+    fits VMEM. Both directions share one (blk, hc) config, so — unlike the
+    q-blocked regime — dropout needs no second feasibility check."""
+    return streaming_cfg(L, H, D, in_itemsize, out_itemsize, rate) is not None
+
+
+def _keep_tile(seed_ref, b, bh, L, blk, qi, ki, rate):
+    u = _uniform_grid(
+        seed_ref[b], bh, L,
+        rows=blk, row_offset=qi * blk,
+        cols=blk, col_offset=ki * blk,
+    )
+    return u >= rate
+
+
+def _stream_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref,
+                       o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                       *, scale: float, rate: float, hc: int, D: int,
+                       L: int):
+    b, hj, qi, ki = (pl.program_id(0), pl.program_id(1),
+                     pl.program_id(2), pl.program_id(3))
+    nk = pl.num_programs(3)
+    blk = q_ref.shape[1]
+    maskb = mask_ref[0, 0, :]                      # [blk] k-slice
+    first = ki == 0
+    for h in range(hc):
+        sl = slice(h * D, (h + 1) * D)
+        q = q_ref[0, :, sl]
+        k = k_ref[0, :, sl]
+        v = v_ref[0, :, sl]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        s = jnp.where(maskb[None, :] > 0, s, _NEG_INF)
+
+        m_old = jnp.where(first, jnp.float32(_NEG_INF), m_ref[h, :, :])
+        l_old = jnp.where(first, 0.0, l_ref[h, :, :])
+        acc_old = jnp.where(first, 0.0, acc_ref[:, sl])
+
+        m_new = jnp.maximum(m_old, jnp.max(s, axis=-1, keepdims=True))
+        # a k-block whose keys are ALL masked for rows no valid key has
+        # reached yet leaves m at _NEG_INF and contributes e = 1 per key —
+        # the first block with a real key then drives alpha = exp(-huge)
+        # to zero and wipes that contamination (same end semantics as the
+        # resident-KV kernels: rows with no valid key anywhere produce
+        # finite garbage that downstream masking ignores)
+        alpha = jnp.exp(m_old - m_new)
+        e = jnp.exp(s - m_new)                     # [blk, blk] f32
+        l_new = alpha * l_old + jnp.sum(e, axis=-1, keepdims=True)
+
+        if rate > 0.0:
+            keep = _keep_tile(seed_ref, b, hj * hc + h, L, blk, qi, ki, rate)
+            e_av = jnp.where(keep, e * (1.0 / (1.0 - rate)), 0.0)
+        else:
+            e_av = e
+        acc_new = alpha * acc_old + jax.lax.dot_general(
+            e_av.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+        m_ref[h, :, :] = m_new
+        l_ref[h, :, :] = l_new
+        acc_ref[:, sl] = acc_new
+
+        @pl.when(ki == nk - 1)
+        def _finish():
+            o_ref[0, :, sl] = (acc_new * (1.0 / l_new)).astype(o_ref.dtype)
+            lse_ref[0, h, :, :] = m_new + jnp.log(l_new)
+
+
+def _stream_tile_ds(q, k, v, g, out, lse, maskb, scale, keep, rate):
+    """Shared [blk, blk] backward tile math: probabilities from the saved
+    row lse, dropout regenerated from absolute indices, softmax row term
+    from the delta identity. Returns (p_drop, ds) in f32."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    s = jnp.where(maskb[None, :] > 0, s, _NEG_INF)
+    p = jnp.exp(s - lse)                           # pre-dropout probs
+    dp_drop = jax.lax.dot_general(
+        g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if keep is not None:
+        inv = jnp.float32(1.0 / (1.0 - rate))
+        p_drop = jnp.where(keep, p * inv, 0.0)
+        dp = jnp.where(keep, dp_drop * inv, 0.0)
+    else:
+        p_drop = p
+        dp = dp_drop
+    row = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )
+    ds = p * (dp - row)
+    return p_drop, ds
+
+
+def _stream_dq_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
+                      out_ref, lse_ref, dq_ref, dqa_ref,
+                      *, scale: float, rate: float, hc: int, D: int,
+                      L: int):
+    b, hj, qi, ki = (pl.program_id(0), pl.program_id(1),
+                     pl.program_id(2), pl.program_id(3))
+    nk = pl.num_programs(3)
+    blk = q_ref.shape[1]
+    maskb = mask_ref[0, 0, :]
+    for h in range(hc):
+        sl = slice(h * D, (h + 1) * D)
+        keep = (
+            _keep_tile(seed_ref, b, hj * hc + h, L, blk, qi, ki, rate)
+            if rate > 0.0 else None
+        )
+        kk = k_ref[0, :, sl]
+        _, ds = _stream_tile_ds(
+            q_ref[0, :, sl], kk, v_ref[0, :, sl],
+            g_ref[0, :, sl], out_ref[0, :, sl], lse_ref[0, h, :, :],
+            maskb, scale, keep, rate,
+        )
+        dq_acc = jnp.where(ki == 0, 0.0, dqa_ref[:, sl]) + jax.lax.dot_general(
+            ds.astype(kk.dtype), kk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dqa_ref[:, sl] = dq_acc
+
+        @pl.when(ki == nk - 1)
+        def _finish():
+            dq_ref[0, :, sl] = (dq_acc * scale).astype(dq_ref.dtype)
+
+
+def _stream_dkv_kernel(seed_ref, mask_ref, k_ref, v_ref, q_ref, g_ref,
+                       out_ref, lse_ref, dk_ref, dv_ref, dka_ref, dva_ref,
+                       *, scale: float, rate: float, hc: int, D: int,
+                       L: int):
+    # note the grid: (B, HJ, nk, nq) — q INNERMOST, so the dk/dv scratch
+    # accumulates across the whole q sweep while k/v blocks stay resident
+    b, hj, ki, qi = (pl.program_id(0), pl.program_id(1),
+                     pl.program_id(2), pl.program_id(3))
+    nq = pl.num_programs(3)
+    blk = k_ref.shape[1]
+    maskb = mask_ref[0, 0, :]
+    for h in range(hc):
+        sl = slice(h * D, (h + 1) * D)
+        keep = (
+            _keep_tile(seed_ref, b, hj * hc + h, L, blk, qi, ki, rate)
+            if rate > 0.0 else None
+        )
+        q = q_ref[0, :, sl]
+        g = g_ref[0, :, sl]
+        p_drop, ds = _stream_tile_ds(
+            q, k_ref[0, :, sl], v_ref[0, :, sl], g,
+            out_ref[0, :, sl], lse_ref[0, h, :, :], maskb, scale, keep, rate,
+        )
+        dv_acc = jnp.where(qi == 0, 0.0, dva_ref[:, sl]) + jax.lax.dot_general(
+            p_drop.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_acc = jnp.where(qi == 0, 0.0, dka_ref[:, sl]) + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dva_ref[:, sl] = dv_acc
+        dka_ref[:, sl] = dk_acc
+
+        @pl.when(qi == nq - 1)
+        def _finish():
+            dk_ref[0, :, sl] = (dk_acc * scale).astype(dk_ref.dtype)
+            dv_ref[0, :, sl] = dv_acc.astype(dv_ref.dtype)
+
+
+def _stream_forward(q, k, v, mask, seed, blk, hc, dtype, rate, interpret):
+    B, L, H, D = q.shape
+    spec_q = pl.BlockSpec((1, blk, hc * D), lambda b, hj, qi, ki, *_: (b, qi, hj))
+    spec_k = pl.BlockSpec((1, blk, hc * D), lambda b, hj, qi, ki, *_: (b, ki, hj))
+    out, lse = pl.pallas_call(
+        functools.partial(_stream_fwd_kernel, scale=1.0 / (D ** 0.5),
+                          rate=rate, hc=hc, D=D, L=L),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H // hc, L // blk, L // blk),
+            in_specs=[
+                pl.BlockSpec((1, 1, blk), lambda b, hj, qi, ki, *_: (b, 0, ki)),
+                spec_q, spec_k, spec_k,
+            ],
+            out_specs=[
+                spec_q,
+                pl.BlockSpec((1, hc, blk, 1),
+                             lambda b, hj, qi, ki, *_: (b, hj, qi, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((blk, hc * D), jnp.float32),   # acc
+                pltpu.VMEM((hc, blk, 1), jnp.float32),    # running max
+                pltpu.VMEM((hc, blk, 1), jnp.float32),    # running denom
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, L, H * D), dtype),
+            jax.ShapeDtypeStruct((B, H, L, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(_row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v))
+    return out.reshape(B, L, H, D), lse
+
+
+def _stream_backward(q, k, v, mask, seed, g, out, lse, blk, hc, dtype, rate,
+                     interpret):
+    B, L, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    spec_q = pl.BlockSpec((1, blk, hc * D), lambda b, hj, qi, ki, *_: (b, qi, hj))
+    spec_k = pl.BlockSpec((1, blk, hc * D), lambda b, hj, qi, ki, *_: (b, ki, hj))
+    spec_lse = pl.BlockSpec((1, hc, blk, 1),
+                            lambda b, hj, qi, ki, *_: (b, hj, qi, 0))
+    args = (_row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k),
+            _fold(v), _fold(g), _fold(out), lse)
+
+    dq = pl.pallas_call(
+        functools.partial(_stream_dq_kernel, scale=scale, rate=rate, hc=hc,
+                          D=D, L=L),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H // hc, L // blk, L // blk),  # (.., nq, nk): k inner
+            in_specs=[
+                pl.BlockSpec((1, 1, blk), lambda b, hj, qi, ki, *_: (b, 0, ki)),
+                spec_q, spec_k, spec_k, spec_q, spec_q, spec_lse,
+            ],
+            out_specs=[spec_q],
+            scratch_shapes=[pltpu.VMEM((blk, hc * D), jnp.float32)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((B, L, H * D), q.dtype)],
+        interpret=interpret,
+    )(*args)[0]
+
+    # same residuals, transposed grid: k/v blocks resident, q sweeps
+    dkv_args = (args[0], args[1], args[3], args[4], args[2], args[5],
+                args[6], args[7])
+    spec_kq = pl.BlockSpec((1, blk, hc * D), lambda b, hj, ki, qi, *_: (b, ki, hj))
+    spec_qq = pl.BlockSpec((1, blk, hc * D), lambda b, hj, ki, qi, *_: (b, qi, hj))
+    dk, dv = pl.pallas_call(
+        functools.partial(_stream_dkv_kernel, scale=scale, rate=rate, hc=hc,
+                          D=D, L=L),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H // hc, L // blk, L // blk),  # (.., nk, nq): q inner
+            in_specs=[
+                pl.BlockSpec((1, 1, blk), lambda b, hj, ki, qi, *_: (b, 0, ki)),
+                spec_kq, spec_kq, spec_qq, spec_qq, spec_qq,
+                pl.BlockSpec((1, hc, blk, 1),
+                             lambda b, hj, ki, qi, *_: (b, hj, qi, 0)),
+            ],
+            out_specs=[spec_kq, spec_kq],
+            scratch_shapes=[
+                pltpu.VMEM((blk, hc * D), jnp.float32),
+                pltpu.VMEM((blk, hc * D), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, L, H * D), k.dtype),
+            jax.ShapeDtypeStruct((B, L, H * D), v.dtype),
+        ],
+        interpret=interpret,
+    )(*dkv_args)
+    return (dq.reshape(B, L, H, D), dk.reshape(B, L, H, D),
+            dv.reshape(B, L, H, D))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _stream_core(q, k, v, mask, seed, dtype, rate, interpret):
+    out, _ = _stream_fwd(q, k, v, mask, seed, dtype, rate, interpret)
+    return out
+
+
+def _stream_fwd(q, k, v, mask, seed, dtype, rate, interpret):
+    B, L, H, D = q.shape
+    cfg = streaming_cfg(L, H, D, q.dtype.itemsize, jnp.dtype(dtype).itemsize,
+                        rate)
+    if cfg is None:
+        raise ValueError(
+            f"no VMEM-feasible streaming config for L={L}, H={H}, D={D} "
+            f"(rate={rate}); gate on supports_streaming"
+        )
+    out, lse = _stream_forward(q, k, v, mask, seed, *cfg, dtype, rate,
+                               interpret)
+    return out, (q, k, v, mask, seed, out, lse)
+
+
+def _stream_bwd(dtype, rate, interpret, residuals, g):
+    q, k, v, mask, seed, out, lse = residuals
+    B, L, H, D = q.shape
+    cfg = streaming_cfg(L, H, D, q.dtype.itemsize, jnp.dtype(dtype).itemsize,
+                        rate)
+    dq, dk, dv = _stream_backward(
+        q, k, v, mask, seed, g.astype(q.dtype), out, lse, *cfg, dtype, rate,
+        interpret,
+    )
+    return dq, dk, dv, None, None
+
+
+_stream_core.defvjp(_stream_fwd, _stream_bwd)
+
+
+def streaming_attention(q, k, v, mask, seed=None, dtype=jnp.float32,
+                        rate=0.0, interpret=False):
+    """Streaming-KV attention over [B, L, H, D] with a [B, L] key mask —
+    the beyond-2k regime (VMEM O(blk^2) per program, any ``L`` a stream
+    block divides). Same contract as ``flash_attention``."""
+    if mask is None:
+        mask = jnp.ones(q.shape[:2], dtype=jnp.int32)
+    if seed is None:
+        seed = jnp.zeros((1,), dtype=jnp.int32)
+    return _stream_core(q, k, v, mask, seed, dtype, rate, interpret)

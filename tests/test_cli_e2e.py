@@ -279,6 +279,12 @@ def test_bench_infer_mode_smoke():
     assert rec["value"] > 0
     assert rec["docs"] == 6
     assert rec["chunks"] >= rec["docs"]  # long docs expand to >= 1 chunk each
+    # round-5 contract fields: the MFU pair is present but NULL off-TPU (a
+    # CPU-smoke ratio against a TPU peak would be noise), and the A/B
+    # provenance knobs are echoed
+    assert rec["mfu"] is None and rec["peak_tflops_bf16"] is None
+    assert rec["model_gflops_per_example"] > 0
+    assert rec["ln_impl"] == "xla" and rec["fetch_every"] == 4
 
 
 def test_bench_converge_mode_smoke():
