@@ -1,15 +1,21 @@
 """Attention ops.
 
 The reference's attention lives inside HF BertModel CUDA kernels (SURVEY.md
-§2.2). Here it is a first-party op with two interchangeable implementations:
+§2.2). Here it is a first-party op with interchangeable implementations:
 
 - ``xla``: plain einsum softmax attention — XLA fuses it well and it runs on
   any backend (used in tests on the CPU mesh).
-- ``pallas``: fused flash-attention TPU kernel (``ops.flash_attention``) that
-  never materialises the [B,H,L,L] score matrix in HBM.
+- ``pallas``: the TPU kernel regimes — fully-fused (L <= 512), q-blocked
+  resident-KV (to ~2k, ``ops.flash_attention``), and streaming-KV
+  FlashAttention-2 beyond that (``ops.flash_streaming``, no single-chip
+  length ceiling). None materialises the [B,H,L,L] score matrix in HBM,
+  and all draw dropout from one absolute-index hash, so regimes are
+  interchangeable without changing the noise stream.
+- ``ring``: sequence-parallel ring attention over the mesh ``seq`` axis
+  (multi-chip long context).
 
-``dot_product_attention`` picks per the ``impl`` argument ('auto' = pallas on
-TPU when shapes qualify, else xla).
+``dot_product_attention`` picks per the ``impl`` argument ('auto' = the
+best-qualifying pallas regime on TPU, else xla).
 """
 
 from __future__ import annotations
