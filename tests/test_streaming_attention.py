@@ -152,6 +152,38 @@ def test_streaming_matches_blocked_kernel_with_dropout_grads():
                                    rtol=1e-4, atol=2e-5, err_msg=name)
 
 
+def test_streaming_4096_flagship_length_with_grads():
+    """The regime's reason to exist, executed end-to-end: L=4096 (8 q x 8 k
+    blocks), padded tail, full fwd + every gradient leaf vs XLA autodiff —
+    the length the resident-KV kernels decline and the dispatcher used to
+    hand to the XLA-fallback HBM path."""
+    q, k, v = _qkv(L=4096, H=2)
+    mask = np.ones((1, 4096), np.int32)
+    mask[0, 3900:] = 0
+    mask = jnp.asarray(mask)
+
+    o_s = streaming_attention(q, k, v, mask, dtype=jnp.float32,
+                              interpret=True)
+    o_x = _xla_attention(q, k, v, mask, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(o_s), np.asarray(o_x),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss_s(q, k, v):
+        o = streaming_attention(q, k, v, mask, dtype=jnp.float32,
+                                interpret=True)
+        return jnp.sum(o ** 2)
+
+    def loss_x(q, k, v):
+        o = _xla_attention(q, k, v, mask, dtype=jnp.float32)
+        return jnp.sum(o ** 2)
+
+    g_s = jax.grad(loss_s, argnums=(0, 1, 2))(q, k, v)
+    g_x = jax.grad(loss_x, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_s, g_x, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=5e-5, err_msg=name)
+
+
 def test_streaming_cfg_feasibility():
     # bert-base long-context shapes: feasible at 4096 and 8192 where the
     # resident-KV regimes decline (that is this regime's reason to exist)
