@@ -77,6 +77,20 @@ def _matmul_gflops_per_example(cfg, L: int, *, train: bool) -> float:
     return fwd * 3 if train else fwd
 
 
+def _widen_positions(cfg, seq_len: int):
+    """Widen the position table to the benched sequence length when it
+    exceeds the preset's (Embeddings raises on out-of-table positions
+    rather than clamping; long-context rows bench the widened-table model —
+    the same model a real long-context run needs)."""
+    if seq_len + cfg.position_offset > cfg.max_position_embeddings:
+        import dataclasses
+
+        return dataclasses.replace(
+            cfg, max_position_embeddings=seq_len + cfg.position_offset
+        )
+    return cfg
+
+
 def _mfu(gflops_per_example: float, examples_per_sec_per_chip: float,
          peak_tflops):
     """Model FLOPs utilization vs the documented peak of the ATTACHED chip
@@ -268,7 +282,7 @@ def bench_infer(args) -> None:
                                # pays the real tokenize-on-read cost
             )
 
-        cfg = MODEL_PRESETS[args.model]
+        cfg = _widen_positions(MODEL_PRESETS[args.model], L)
         model = QAModel(cfg, dtype=jnp.bfloat16, attention_impl="auto",
                         ln_impl=args.ln_impl)
         params = model.init(
@@ -464,6 +478,9 @@ def main() -> None:
     parser.add_argument("--fetch_every", type=int, default=4,
                         help="infer mode: group output fetches over this many "
                              "batches (1 = per-batch)")
+    parser.add_argument("--remat", action="store_true",
+                        help="train mode: rematerialize encoder layers "
+                             "(activation-memory headroom for seq >= 8k)")
     # --mode infer knobs (192 docs x ~12 chunks = 9 batches/pass: enough to
     # reach the loader/device pipeline's steady state)
     parser.add_argument("--infer_docs", type=int, default=192)
@@ -505,8 +522,9 @@ def main() -> None:
     mesh = build_mesh()
 
     cfg = MODEL_PRESETS[args.model]
+    cfg = _widen_positions(cfg, args.seq_len)
     model = QAModel(cfg, dtype=jnp.bfloat16, attention_impl="auto",
-                    ln_impl=args.ln_impl)
+                    ln_impl=args.ln_impl, remat=args.remat)
 
     class TP:
         loss = "smooth"; smooth_alpha = 0.01; focal_alpha = 1; focal_gamma = 2

@@ -250,6 +250,11 @@ def get_model_parser() -> ConfigArgumentParser:
     parser.add_argument("--attention_probs_dropout_prob", type=float, default=0.1,
                         help="Attention dropout probability.")
     parser.add_argument("--layer_norm_eps", type=float, default=1e-12, help="Layer norm eps.")
+    parser.add_argument("--max_position_embeddings", type=cast2(int), default=None,
+                        help="Widen the position-embedding table past the "
+                             "preset's (required for max_seq_len beyond it — "
+                             "positions past the table are a hard error, "
+                             "never a silent clamp).")
 
     parser.add_argument("--vocab_file", type=cast2(str), default=None,
                         help="Path to WordPiece/BPE vocab.")

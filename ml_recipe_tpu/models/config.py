@@ -72,6 +72,11 @@ def resolve_model_config(model_params, *, num_labels: int = 5) -> EncoderConfig:
     dropout/layer-norm overrides are applied on top of the preset)."""
     name = getattr(model_params, "model", "bert-base-uncased")
     preset = MODEL_PRESETS[name]
+    # long-context: an explicit --max_position_embeddings widens the
+    # position table past the preset's (positions beyond it are a
+    # trace-time error in Embeddings, never a silent clamp)
+    mpe = getattr(model_params, "max_position_embeddings", None) \
+        or preset.max_position_embeddings
     return dataclasses.replace(
         preset,
         hidden_dropout_prob=getattr(model_params, "hidden_dropout_prob", preset.hidden_dropout_prob),
@@ -79,5 +84,6 @@ def resolve_model_config(model_params, *, num_labels: int = 5) -> EncoderConfig:
             model_params, "attention_probs_dropout_prob", preset.attention_probs_dropout_prob
         ),
         layer_norm_eps=getattr(model_params, "layer_norm_eps", preset.layer_norm_eps),
+        max_position_embeddings=mpe,
         num_labels=num_labels,
     )

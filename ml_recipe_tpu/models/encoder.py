@@ -65,7 +65,19 @@ class Embeddings(nn.Module):
         word = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="word_embeddings",
                         dtype=self.dtype)(input_ids)
 
-        positions = jnp.arange(input_ids.shape[-1], dtype=jnp.int32) + cfg.position_offset
+        L = input_ids.shape[-1]
+        if L + cfg.position_offset > cfg.max_position_embeddings:
+            # fail at TRACE time (L is static) instead of letting the
+            # clip-mode embedding gather silently hand every position past
+            # the table its last row — a model that trains and benches fine
+            # with no positional signal beyond the table (review r5)
+            raise ValueError(
+                f"sequence length {L} (+offset {cfg.position_offset}) "
+                f"exceeds max_position_embeddings="
+                f"{cfg.max_position_embeddings}; widen the position table "
+                f"(--max_position_embeddings) for long-context runs"
+            )
+        positions = jnp.arange(L, dtype=jnp.int32) + cfg.position_offset
         pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
                        name="position_embeddings", dtype=self.dtype)(positions)[None, :, :]
 

@@ -118,9 +118,47 @@ def hf_to_encoder_params(state_dict: Dict[str, np.ndarray], num_layers: int) -> 
 def load_pretrained_into(params: dict, path_or_name: str, num_layers: int) -> dict:
     """Replace the ``transformer`` subtree of initialized QA-model params with
     converted HF weights (heads stay freshly initialized, matching the
-    reference where only the trunk is pretrained)."""
+    reference where only the trunk is pretrained).
+
+    The position table is reconciled with the TARGET's size: a widened
+    long-context table keeps its freshly-initialized tail under the
+    pretrained prefix (so ``--max_position_embeddings 4096`` + HF
+    warm-start trains real embeddings past row 511 instead of the
+    checkpoint's 512-row table silently shrinking the model — review r5);
+    a narrower target truncates. Any OTHER shape mismatch is a hard error:
+    replacing the subtree with wrong-shaped arrays would corrupt the model
+    silently (flax apply does not re-validate param shapes)."""
+
     sd = load_hf_state_dict(path_or_name)
     encoder = hf_to_encoder_params(sd, num_layers)
+
+    tgt_tab = np.asarray(
+        params["transformer"]["embeddings"]["position_embeddings"]["embedding"]
+    )
+    src_tab = encoder["embeddings"]["position_embeddings"]["embedding"]
+    if src_tab.shape[0] != tgt_tab.shape[0]:
+        n = min(src_tab.shape[0], tgt_tab.shape[0])
+        merged = tgt_tab.copy()
+        merged[:n] = src_tab[:n]
+        encoder["embeddings"]["position_embeddings"]["embedding"] = merged
+        if tgt_tab.shape[0] > src_tab.shape[0]:
+            logger.warning(
+                f"Position table widened: pretrained rows 0..{n - 1} copied "
+                f"from the {src_tab.shape[0]}-row checkpoint; rows {n}.."
+                f"{tgt_tab.shape[0] - 1} stay freshly initialized (train "
+                f"them: they carry no pretrained signal)."
+            )
+        else:
+            logger.warning(
+                f"Position table truncated: the model keeps the first {n} "
+                f"of the checkpoint's {src_tab.shape[0]} pretrained rows "
+                f"(sequences here never index past {n - 1})."
+            )
+
+    from ..utils.params import check_param_shapes
+
+    check_param_shapes(params["transformer"], encoder,
+                       f"converted checkpoint {path_or_name}")
 
     new_params = dict(params)
     new_params["transformer"] = encoder

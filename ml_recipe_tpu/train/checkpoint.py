@@ -406,6 +406,7 @@ def load_state_dict_sharded(
         return serialization.from_state_dict(target, sd)
 
     new_params = _restore(params, "model")
+    _check_restored_param_shapes(params, new_params, path)
     logger.info(f"Model weights were loaded from sharded checkpoint {path}.")
 
     new_opt_state = opt_state
@@ -443,6 +444,15 @@ def _strip_legacy_clip_state(node):
             }
         return {k: _strip_legacy_clip_state(v) for k, v in node.items()}
     return node
+
+
+def _check_restored_param_shapes(target, restored, path) -> None:
+    """Hard error when a restored leaf's shape differs from the model's
+    (e.g. a preset-table checkpoint restored into a widened long-context
+    model — see utils/params.py for why this must be explicit)."""
+    from ..utils.params import check_param_shapes
+
+    check_param_shapes(target, restored, f"checkpoint {path}")
 
 
 def load_state_dict(
@@ -504,6 +514,7 @@ def load_state_dict(
         state = serialization.msgpack_restore(fh.read())
 
     new_params = serialization.from_state_dict(params, state["model"])
+    _check_restored_param_shapes(params, new_params, path)
     logger.info(f"Model weights were loaded from {path} checkpoint.")
 
     new_opt_state = opt_state

@@ -1,0 +1,36 @@
+"""Param-tree validation shared by checkpoint restore and HF warm-start.
+
+``flax.serialization.from_state_dict`` is structural, not shape-checked,
+and flax ``apply`` never re-validates param shapes — XLA's clamp-mode
+gathers can then make wrong-shaped tables invisible until quality numbers
+come in (review r5). Every path that swaps arrays into a live param tree
+routes through this one check so the error message and the rule cannot
+drift between callers."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def check_param_shapes(target, restored, context: str) -> None:
+    """Raise ``ValueError`` when any restored leaf's shape differs from the
+    model's. ``context`` names the source (checkpoint path, HF name) for
+    the error message. Callers guarantee matching tree structure
+    (``from_state_dict`` enforces it; the HF converter builds the same
+    schema)."""
+    import jax
+
+    mismatched = [
+        f"{jax.tree_util.keystr(kp)}: source {np.shape(b)} vs model "
+        f"{np.shape(a)}"
+        for (kp, a), b in zip(
+            jax.tree_util.tree_flatten_with_path(target)[0],
+            jax.tree_util.tree_leaves(restored),
+        )
+        if np.shape(a) != np.shape(b)
+    ]
+    if mismatched:
+        raise ValueError(
+            f"{context} does not fit the model config; mismatched param "
+            f"shapes at: {mismatched[:5]}"
+        )

@@ -61,9 +61,12 @@ run elementwise_floor python scripts/perf_elementwise_floor.py
 if [ "${1:-full}" != "quick" ]; then
   run bench_seq1024   python bench.py --seq_len 1024 --global_batch 128
   run bench_seq2048   python bench.py --seq_len 2048 --global_batch 32
-  # streaming-KV regime (round 5): first-ever 4096 single-chip number —
-  # the dispatcher routed this length to XLA before, unbenched
+  # streaming-KV regime (round 5): first-ever 4096/8192 single-chip
+  # numbers — the dispatcher routed these lengths to XLA before, unbenched
+  # (8192 adds --remat for activation-memory headroom; if it still OOMs,
+  # the run() wrapper records the failure and the capture continues)
   run bench_seq4096   python bench.py --seq_len 4096 --global_batch 16
+  run bench_seq8192   python bench.py --seq_len 8192 --global_batch 8 --remat
   run infer_decomp    python scripts/perf_infer_decomposition.py \
                         --model bert-base-uncased --seq_len 512 \
                         --global_batch 256 --infer_docs 192 \

@@ -168,3 +168,17 @@ def test_mfu_fields_auditable(bench):
     # must not be scored against the v5e peak)
     peaks = dict(bench.TPU_BF16_PEAK_TFLOPS)
     assert peaks["v5 lite"] == 197.0 and peaks["v4"] == 275.0
+
+
+def test_widen_positions_for_long_bench(bench):
+    """Long-context bench rows must run the widened-table model (the one a
+    real long-context run needs), not a clamped 512-row table."""
+    from ml_recipe_tpu.models import MODEL_PRESETS
+
+    cfg = MODEL_PRESETS["bert-base-uncased"]
+    assert bench._widen_positions(cfg, 512) is cfg  # within table: untouched
+    wide = bench._widen_positions(cfg, 4096)
+    assert wide.max_position_embeddings == 4096
+    rob = MODEL_PRESETS["roberta-base"]  # offset 2, table 514
+    assert bench._widen_positions(rob, 512) is rob
+    assert bench._widen_positions(rob, 1024).max_position_embeddings == 1026

@@ -287,3 +287,140 @@ def test_golden_vectors_real_weights():
     np.testing.assert_allclose(
         goldens["final_norm"], committed["final_norm"], rtol=1e-4
     )
+
+
+@pytest.mark.unit
+def test_positions_past_table_raise_not_clamp():
+    """Review r5: a sequence longer than the position table must be a
+    TRACE-time error with actionable guidance — the clip-mode embedding
+    gather would otherwise hand every position past the table its last row
+    and the model would train/bench fine with no positional signal there."""
+    from ml_recipe_tpu.models import EncoderConfig, QAModel
+
+    cfg = EncoderConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                        num_heads=2, intermediate_size=32,
+                        max_position_embeddings=16, num_labels=5)
+    model = QAModel(cfg)
+    ids_ok = jnp.zeros((1, 16), jnp.int32)
+    model.init(jax.random.key(0), ids_ok)  # at the limit: fine
+    ids_long = jnp.zeros((1, 17), jnp.int32)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        model.init(jax.random.key(0), ids_long)
+    # roberta's +2 position offset consumes table rows too
+    cfg_off = EncoderConfig(model_type="roberta", vocab_size=64,
+                            hidden_size=32, num_layers=1, num_heads=2,
+                            intermediate_size=32, max_position_embeddings=16,
+                            type_vocab_size=1, position_offset=2,
+                            num_labels=5)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        QAModel(cfg_off).init(jax.random.key(0), ids_ok)  # 16+2 > 16
+
+
+@pytest.mark.unit
+def test_resolve_model_config_position_table_override():
+    """--max_position_embeddings widens the preset's table (the long-context
+    knob); unset keeps the preset."""
+    from types import SimpleNamespace
+
+    from ml_recipe_tpu.models.config import resolve_model_config
+
+    base = resolve_model_config(SimpleNamespace(model="bert-base-uncased"))
+    assert base.max_position_embeddings == 512
+    wide = resolve_model_config(
+        SimpleNamespace(model="bert-base-uncased",
+                        max_position_embeddings=4096)
+    )
+    assert wide.max_position_embeddings == 4096
+    none_set = resolve_model_config(
+        SimpleNamespace(model="bert-base-uncased",
+                        max_position_embeddings=None)
+    )
+    assert none_set.max_position_embeddings == 512
+
+
+@pytest.mark.unit
+def test_warm_start_reconciles_widened_position_table(tmp_path):
+    """HF warm-start into a widened long-context model: the pretrained
+    prefix lands in the first rows, the widened tail KEEPS its fresh
+    initialization (review r5: the 512-row checkpoint table must not
+    silently shrink the model behind the cfg's back), and any non-position
+    shape mismatch is a hard error."""
+    pytest.importorskip("torch")
+    from transformers import BertConfig, BertModel
+
+    from ml_recipe_tpu.models import QAModel
+    from ml_recipe_tpu.models.hf_convert import load_pretrained_into
+
+    hf_cfg = BertConfig(
+        vocab_size=100, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2,
+    )
+    hf_model = BertModel(hf_cfg).eval()
+    hf_model.save_pretrained(tmp_path / "hf")
+
+    cfg = EncoderConfig(
+        vocab_size=100, hidden_size=32, num_layers=2, num_heads=4,
+        intermediate_size=64, max_position_embeddings=128,  # widened
+    )
+    params = QAModel(cfg).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    init_tab = np.asarray(
+        params["transformer"]["embeddings"]["position_embeddings"]["embedding"]
+    ).copy()
+
+    out = load_pretrained_into(params, str(tmp_path / "hf"), cfg.num_layers)
+    tab = np.asarray(
+        out["transformer"]["embeddings"]["position_embeddings"]["embedding"]
+    )
+    hf_tab = hf_model.state_dict()[
+        "embeddings.position_embeddings.weight"
+    ].detach().numpy()
+    assert tab.shape == (128, 32)
+    np.testing.assert_array_equal(tab[:64], hf_tab)       # pretrained prefix
+    np.testing.assert_array_equal(tab[64:], init_tab[64:])  # fresh tail
+
+    # non-position mismatch (hidden size) must raise, not corrupt silently
+    cfg_bad = EncoderConfig(
+        vocab_size=100, hidden_size=64, num_layers=2, num_heads=4,
+        intermediate_size=64, max_position_embeddings=64,
+    )
+    params_bad = QAModel(cfg_bad).init(
+        jax.random.key(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    with pytest.raises(ValueError, match="mismatched param shapes"):
+        load_pretrained_into(params_bad, str(tmp_path / "hf"), 2)
+
+
+@pytest.mark.unit
+def test_checkpoint_restore_rejects_shape_mismatch(tmp_path):
+    """A checkpoint from a different architecture config must be a hard
+    error on restore — flax's structural from_state_dict would otherwise
+    replace leaves silently (review r5: e.g. a preset-table checkpoint
+    restored into a widened long-context model)."""
+    from ml_recipe_tpu.models import QAModel
+    from ml_recipe_tpu.train.checkpoint import (
+        load_state_dict,
+        save_state_dict,
+    )
+
+    cfg_a = EncoderConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                          num_heads=2, intermediate_size=32,
+                          max_position_embeddings=16)
+    cfg_b = EncoderConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                          num_heads=2, intermediate_size=32,
+                          max_position_embeddings=32)  # widened table
+    ids = jnp.zeros((1, 8), jnp.int32)
+    params_a = QAModel(cfg_a).init(jax.random.key(0), ids)["params"]
+    params_b = QAModel(cfg_b).init(jax.random.key(0), ids)["params"]
+
+    ckpt = tmp_path / "last.ch"
+    save_state_dict(ckpt, params=params_a)
+    # same config restores fine
+    restored, _, _, _ = load_state_dict(ckpt, params=params_a)
+    assert jax.tree_util.tree_structure(restored) \
+        == jax.tree_util.tree_structure(params_a)
+    # widened-config restore of the narrow checkpoint: loud error
+    with pytest.raises(ValueError, match="does not fit the model config"):
+        load_state_dict(ckpt, params=params_b)
