@@ -471,13 +471,21 @@ def main() -> None:
     parser.add_argument("--model", type=str, default="bert-base-uncased")
     parser.add_argument("--ln_impl", type=str, default="xla",
                         choices=("xla", "fused", "auto", "interpret"),
-                        help="LayerNorm implementation for the A/B "
-                             "(ops/layer_norm.py; default stays on the "
-                             "recorded-baseline XLA path; interpret = CPU "
-                             "smoke of the kernel path)")
-    parser.add_argument("--fetch_every", type=int, default=4,
+                        help="LayerNorm implementation (ops/layer_norm.py). "
+                             "Default stays 'xla': the round-5 on-chip A/B "
+                             "measured the fused kernel a wash (732.2 vs "
+                             "729.2 ms/step — it removes the elementwise "
+                             "bytes but XLA already fused that work into "
+                             "matmul epilogues; artifacts/r4/elementwise_"
+                             "floor{,_lnfused}.json). interpret = CPU smoke "
+                             "of the kernel path")
+    parser.add_argument("--fetch_every", type=int, default=1,
                         help="infer mode: group output fetches over this many "
-                             "batches (1 = per-batch)")
+                             "batches (1 = per-batch). Default reverted to 1 "
+                             "by the round-5 on-chip sweep: 423/408/394 "
+                             "chunks/s at 1/4/8 (artifacts/r4/bench_infer_"
+                             "fetch*.json) — grouping lost when the loop was "
+                             "loader-bound, not fetch-bound")
     parser.add_argument("--remat", action="store_true",
                         help="train mode: rematerialize encoder layers "
                              "(activation-memory headroom for seq >= 8k)")

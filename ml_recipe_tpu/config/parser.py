@@ -285,11 +285,14 @@ def get_model_parser() -> ConfigArgumentParser:
                              "FLOPs for HBM.")
     parser.add_argument("--ln_impl", type=cast2(str), default="xla",
                         choices=[None, "xla", "fused", "auto", "interpret"],
-                        help="LayerNorm implementation: xla (default), fused "
-                             "(one-pass Pallas backward on TPU; falls back to "
-                             "xla off-TPU), auto (fused on TPU when the "
-                             "geometry qualifies), interpret (kernel under "
-                             "pallas interpret mode — tests only).")
+                        help="LayerNorm implementation: xla (default — the "
+                             "round-5 on-chip A/B measured the fused kernel "
+                             "a wash, XLA already fuses LN into matmul "
+                             "epilogues), fused (one-pass Pallas backward "
+                             "on TPU; falls back to xla off-TPU), auto "
+                             "(fused on TPU when the geometry qualifies), "
+                             "interpret (kernel under pallas interpret mode "
+                             "— tests only).")
 
     return parser
 
@@ -485,10 +488,13 @@ def get_predictor_parser() -> ConfigArgumentParser:
     parser.add_argument("--limit", type=cast2(int), default=None,
                         help="Process only specified number of documents.")
 
-    parser.add_argument("--fetch_every", type=int, default=4,
+    parser.add_argument("--fetch_every", type=int, default=1,
                         help="Group device->host output fetches over this many "
                              "completed batches (amortizes per-fetch RTT on "
-                             "tunneled backends; 1 = fetch per batch).")
+                             "tunneled backends; 1 = fetch per batch, the "
+                             "measured round-5 default — grouping only pays "
+                             "when the loop is fetch-bound, sweep it with "
+                             "bench.py --mode infer --fetch_every N).")
 
     parser.add_argument("--gpu_compat", action="store_true",
                         help="Accepted for reference-config compatibility.")

@@ -64,7 +64,7 @@ class Predictor:
         n_jobs: int = 16,
         buffer_size: int = 4096,
         limit: Optional[int] = None,
-        fetch_every: int = 4,
+        fetch_every: int = 1,
     ):
         self.model = model
         self.params = params
@@ -82,8 +82,12 @@ class Predictor:
         # outputs are fetched in groups of ``fetch_every`` completed batches
         # (one device->host transfer instead of one per batch) while 2 more
         # stay in flight — a high-RTT channel pays its round-trip latency
-        # once per group instead of once per [6, B] output. 1 = per-batch
-        # fetching (the pre-round-4 behavior).
+        # once per group instead of once per [6, B] output. Default 1 =
+        # per-batch fetching: the round-5 on-chip sweep measured grouping
+        # NEGATIVE (423/408/394 chunks/s at 1/4/8, artifacts/r4/
+        # bench_infer_fetch*.json) because that loop was loader-bound —
+        # grouping only pays when per-fetch RTT dominates; sweep before
+        # raising it.
         self.fetch_every = max(1, int(fetch_every))
 
         self.dump = None

@@ -36,7 +36,14 @@ class QAModel(nn.Module):
     attention_impl: str = "xla"
     remat: bool = False
     mesh: Any = None  # required by attention_impl='ring'
-    ln_impl: str = "xla"  # 'fused' = one-pass Pallas LN backward (ops/layer_norm.py)
+    # 'auto'/'fused' = one-pass Pallas LN backward (ops/layer_norm.py).
+    # Default stays 'xla': the round-5 on-chip A/B measured the kernel a
+    # wash (−0.4%: 732.2 vs 729.2 ms/step on a quiet chip) — it removes
+    # the predicted HBM bytes (elementwise 46.6→28.5 ms/step, matmul
+    # 468→448) but the custom calls add ~37.5 ms back, because XLA was
+    # already fusing the LN work into matmul epilogues. Full decomposition:
+    # artifacts/r4/elementwise_floor{,_lnfused}.json + bench_seq512_*.json.
+    ln_impl: str = "xla"
 
     @nn.compact
     def __call__(

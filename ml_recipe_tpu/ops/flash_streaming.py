@@ -46,7 +46,10 @@ from .flash_attention import (
     _VMEM_BUDGET,
     _fold,
     _legal_head_chunks,
+    _lse_pack,
+    _lse_unpack,
     _row_seeds,
+    _sublane8,
     _uniform_grid,
 )
 
@@ -67,7 +70,7 @@ def streaming_cfg(L: int, H: int, D: int, in_itemsize: int,
     uniform tile when ``rate > 0``; no compile probe here, so the paper
     arithmetic must not run the budget to the wire); per-stream blocks of
     hc*D lanes double-buffered at their own itemsizes (q, k, v, g, out in;
-    dk, dv out) plus the lane-padded [1, hc, blk, 1] lse block; f32
+    dk, dv out) plus the (1, 1, 1, hc*blk) lse wire block; f32
     accumulator scratch (2 x [blk, hc*D] in the dk/dv kernel, 1 + the
     [hc, blk, 1] m/l pair in the forward — scratch is not double-buffered).
     """
@@ -85,7 +88,7 @@ def streaming_cfg(L: int, H: int, D: int, in_itemsize: int,
         block_bytes = (
             2 * blk * lanes * (4 + 2) * in_itemsize  # q k v g + dk,dv
             + 2 * blk * lanes * out_itemsize         # out residual
-            + hc * 2 * blk * 128 * 4                 # lse block, lane-padded
+            + hc * 2 * _sublane8(1) * blk * 4        # lse wire block
         )
         scratch_bytes = 2 * blk * lanes * 4 + 2 * hc * blk * 128 * 4
         if block_bytes + scratch_bytes + tile_bytes <= _VMEM_BUDGET:
@@ -164,7 +167,9 @@ def _stream_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref,
         @pl.when(ki == nk - 1)
         def _finish():
             o_ref[0, :, sl] = (acc_new * (1.0 / l_new)).astype(o_ref.dtype)
-            lse_ref[0, h, :, :] = m_new + jnp.log(l_new)
+            lse_ref[0, 0, 0, h * blk:(h + 1) * blk] = (
+                m_new + jnp.log(l_new)
+            )[:, 0]  # lane row at the head-major offset (_lse_pack)
 
 
 def _stream_tile_ds(q, k, v, g, out, lse, maskb, scale, keep, rate):
@@ -213,7 +218,8 @@ def _stream_dq_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
         kk = k_ref[0, :, sl]
         _, ds = _stream_tile_ds(
             q_ref[0, :, sl], kk, v_ref[0, :, sl],
-            g_ref[0, :, sl], out_ref[0, :, sl], lse_ref[0, h, :, :],
+            g_ref[0, :, sl], out_ref[0, :, sl],
+            lse_ref[0, 0, 0, h * blk:(h + 1) * blk][:, None],
             maskb, scale, keep, rate,
         )
         dq_acc = jnp.where(ki == 0, 0.0, dqa_ref[:, sl]) + jax.lax.dot_general(
@@ -248,7 +254,9 @@ def _stream_dkv_kernel(seed_ref, mask_ref, k_ref, v_ref, q_ref, g_ref,
         g = g_ref[0, :, sl]
         p_drop, ds = _stream_tile_ds(
             q, k_ref[0, :, sl], v_ref[0, :, sl], g,
-            out_ref[0, :, sl], lse_ref[0, h, :, :], maskb, scale, keep, rate,
+            out_ref[0, :, sl],
+            lse_ref[0, 0, 0, h * blk:(h + 1) * blk][:, None],
+            maskb, scale, keep, rate,
         )
         dv_acc = jnp.where(qi == 0, 0.0, dva_ref[:, sl]) + jax.lax.dot_general(
             p_drop.astype(g.dtype), g, (((0,), (0,)), ((), ())),
@@ -283,8 +291,8 @@ def _stream_forward(q, k, v, mask, seed, blk, hc, dtype, rate, interpret):
             ],
             out_specs=[
                 spec_q,
-                pl.BlockSpec((1, hc, blk, 1),
-                             lambda b, hj, qi, ki, *_: (b, hj, qi, 0)),
+                pl.BlockSpec((1, 1, 1, hc * blk),
+                             lambda b, hj, qi, ki, *_: (b, qi, 0, hj)),
             ],
             scratch_shapes=[
                 pltpu.VMEM((blk, hc * D), jnp.float32),   # acc
@@ -294,11 +302,11 @@ def _stream_forward(q, k, v, mask, seed, blk, hc, dtype, rate, interpret):
         ),
         out_shape=[
             jax.ShapeDtypeStruct((B, L, H * D), dtype),
-            jax.ShapeDtypeStruct((B, H, L, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B, L // blk, 1, H * blk), jnp.float32),
         ],
         interpret=interpret,
     )(_row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v))
-    return out.reshape(B, L, H, D), lse
+    return out.reshape(B, L, H, D), _lse_unpack(lse, blk, H)
 
 
 def _stream_backward(q, k, v, mask, seed, g, out, lse, blk, hc, dtype, rate,
@@ -307,10 +315,10 @@ def _stream_backward(q, k, v, mask, seed, g, out, lse, blk, hc, dtype, rate,
     scale = 1.0 / (D ** 0.5)
     spec_q = pl.BlockSpec((1, blk, hc * D), lambda b, hj, qi, ki, *_: (b, qi, hj))
     spec_k = pl.BlockSpec((1, blk, hc * D), lambda b, hj, qi, ki, *_: (b, ki, hj))
-    spec_lse = pl.BlockSpec((1, hc, blk, 1),
-                            lambda b, hj, qi, ki, *_: (b, hj, qi, 0))
+    spec_lse = pl.BlockSpec((1, 1, 1, hc * blk),
+                            lambda b, hj, qi, ki, *_: (b, qi, 0, hj))
     args = (_row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k),
-            _fold(v), _fold(g), _fold(out), lse)
+            _fold(v), _fold(g), _fold(out), _lse_pack(lse, blk))
 
     dq = pl.pallas_call(
         functools.partial(_stream_dq_kernel, scale=scale, rate=rate, hc=hc,
@@ -343,8 +351,8 @@ def _stream_backward(q, k, v, mask, seed, g, out, lse, blk, hc, dtype, rate,
             in_specs=[
                 pl.BlockSpec((1, 1, blk), lambda b, hj, ki, qi, *_: (b, 0, ki)),
                 spec_kq, spec_kq, spec_qq, spec_qq, spec_qq,
-                pl.BlockSpec((1, hc, blk, 1),
-                             lambda b, hj, ki, qi, *_: (b, hj, qi, 0)),
+                pl.BlockSpec((1, 1, 1, hc * blk),
+                             lambda b, hj, ki, qi, *_: (b, qi, 0, hj)),
             ],
             out_specs=[spec_kq, spec_kq],
             scratch_shapes=[

@@ -96,8 +96,20 @@ def main() -> None:
     print(
         f"B={B} L={L} H={H} D={D} rate={args.rate}: "
         f"fwd {t_fwd:.2f} ms, fwd+bwd {t_both:.2f} ms, "
-        f"bwd≈{t_both - t_fwd:.2f} ms per layer-micro"
+        f"bwd≈{t_both - t_fwd:.2f} ms per layer-micro",
+        file=sys.stderr,
     )
+    # machine line LAST on stdout: the capture runbook keeps `grep "^{"`
+    import json
+
+    print(json.dumps({
+        "metric": "attn_kernel_ms_per_layer_micro",
+        "batch": B, "seq": L, "heads": H, "dim": D, "rate": args.rate,
+        "fwd_ms": round(t_fwd, 3),
+        "fwd_bwd_ms": round(t_both, 3),
+        "bwd_ms": round(t_both - t_fwd, 3),
+        "device": str(jax.devices()[0].device_kind),
+    }))
 
 
 if __name__ == "__main__":

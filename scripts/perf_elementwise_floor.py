@@ -43,16 +43,6 @@ def _collect_op_profile(trace_dir: str):
     return json.loads(data) if isinstance(data, (str, bytes)) else data
 
 
-def _walk_leaves(node, out):
-    children = node.get("children") or []
-    metrics = node.get("metrics") or {}
-    if not children and metrics:
-        out.append(node)
-    for c in children:
-        _walk_leaves(c, out)
-    return out
-
-
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=3)
@@ -63,7 +53,13 @@ def main() -> int:
     p.add_argument("--model", default="bert-base-uncased")
     # v5e HBM peak ~819 GB/s (16 GB HBM2); override per chip generation
     p.add_argument("--peak_gbps", type=float, default=819.0)
+    p.add_argument("--ln_impl", default="xla", choices=["xla", "fused"])
+    # re-parse a saved trace (no chip needed) instead of capturing a new one
+    p.add_argument("--trace_dir", default=None)
     args = p.parse_args()
+
+    if args.trace_dir:
+        return _report(args, args.trace_dir)
 
     import jax
     import jax.numpy as jnp
@@ -81,7 +77,8 @@ def main() -> int:
 
     mesh = build_mesh()
     cfg = MODEL_PRESETS[args.model]
-    model = QAModel(cfg, dtype=jnp.bfloat16, attention_impl="auto")
+    model = QAModel(cfg, dtype=jnp.bfloat16, attention_impl="auto",
+                    ln_impl=args.ln_impl)
 
     class TP:
         loss = "smooth"; smooth_alpha = 0.01; focal_alpha = 1; focal_gamma = 2
@@ -133,41 +130,53 @@ def main() -> int:
                     params_d, opt_d, inputs, labels, warmup + i)
             float(values["loss"])
 
-    prof = _collect_op_profile(trace_dir)
-    root = prof.get("byCategory") or prof.get("by_category") or prof
-    leaves = _walk_leaves(root, [])
+    return _report(args, trace_dir)
 
-    def classify(name: str, category: str) -> str:
+
+def _report(args, trace_dir: str) -> int:
+    prof = _collect_op_profile(trace_dir)
+    # xprof op_profile shape (verified on a real round-5 chip trace): no
+    # byCategory on this version — programs live under byProgramExcludeIdle,
+    # each program's CHILDREN are the XLA op categories ('convolution
+    # fusion', 'custom-call', 'loop fusion', ...), and each category's
+    # children are the individual fusions carrying rawTime (ps, summed over
+    # traced steps) + rawBytesAccessedArray ([hbm, ...] bytes). Deeper
+    # leaves are per-HLO rows with zero time — time is attributed at the
+    # fusion level, so walk exactly program -> category -> fusion.
+    root = prof.get("byProgramExcludeIdle") or prof.get("byProgram") or prof
+    programs = root.get("children") or []
+
+    def classify(category: str) -> str:
         lc = (category or "").lower()
-        ln = (name or "").lower()
-        if "custom-call" in lc or "custom" in ln:
+        if "custom" in lc:  # 'custom-call' + 'custom fusion' = Pallas/attn
             return "attention_kernels"
-        if "convolution" in lc or "dot" in ln or "matmul" in lc:
+        if "convolution" in lc:
             return "matmul"
-        if "fusion" in lc or "loop" in lc or "elementwise" in lc:
+        if "loop fusion" in lc or "elementwise" in lc:
             return "elementwise_fusion"
         return "other"
 
     cats: dict = {}
     fusion_rows = []
-    for leaf in leaves:
-        m = leaf["metrics"]
-        # op_profile metrics: time fraction, normalized flops, bandwidth
-        # utilizations; rawTime (ps) and rawBytesAccessed when present
-        t_ps = float(m.get("rawTime", 0.0))
-        bytes_acc = float(m.get("rawBytesAccessed", 0.0))
-        cat = classify(leaf.get("name", ""), leaf.get("category", ""))
-        c = cats.setdefault(cat, {"time_ms": 0.0, "bytes": 0.0})
-        c["time_ms"] += t_ps / 1e9
-        c["bytes"] += bytes_acc
-        if cat == "elementwise_fusion" and t_ps > 0:
-            fusion_rows.append({
-                "name": leaf.get("name", "?")[:80],
-                "time_ms": round(t_ps / 1e9, 3),
-                "gbytes": round(bytes_acc / 1e9, 3),
-                "achieved_gbps": round(bytes_acc / (t_ps / 1e12) / 1e9, 1)
-                if t_ps else None,
-            })
+    for program in programs:
+        for cat_node in program.get("children") or []:
+            cat = classify(cat_node.get("name", ""))
+            c = cats.setdefault(cat, {"time_ms": 0.0, "bytes": 0.0})
+            for fusion in cat_node.get("children") or []:
+                m = fusion.get("metrics") or {}
+                t_ps = float(m.get("rawTime", 0.0))
+                ba = m.get("rawBytesAccessedArray") or [0.0]
+                bytes_acc = float(ba[0])  # index 0 = HBM space
+                c["time_ms"] += t_ps / 1e9
+                c["bytes"] += bytes_acc
+                if cat == "elementwise_fusion" and t_ps > 0:
+                    fusion_rows.append({
+                        "name": fusion.get("name", "?")[:80],
+                        "time_ms": round(t_ps / 1e9, 3),
+                        "gbytes": round(bytes_acc / 1e9, 3),
+                        "achieved_gbps": round(
+                            bytes_acc / (t_ps / 1e12) / 1e9, 1),
+                    })
 
     fusion_rows.sort(key=lambda r: -r["time_ms"])
     ew = cats.get("elementwise_fusion", {"time_ms": 0.0, "bytes": 0.0})
@@ -175,6 +184,7 @@ def main() -> int:
                 if ew["time_ms"] else None)
     print(json.dumps({
         "metric": "elementwise_bwd_floor",
+        "ln_impl": args.ln_impl,
         "steps_traced": args.steps,
         "per_category_ms_per_step": {
             k: round(v["time_ms"] / args.steps, 2) for k, v in cats.items()

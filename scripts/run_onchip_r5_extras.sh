@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Round-5 follow-up capture (chip-free window after run_onchip_r4.sh):
+# re-runs the two tools that mis-fired in the main capture and adds the
+# cross-checks the A/B discipline wants — a second clean baseline for the
+# LN delta, the fused-LN trace, a converge re-proof, and the other models.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p artifacts/r4
+run() {
+  local name="$1"; shift
+  echo "=== $name: $*" >&2
+  if "$@" > "artifacts/r4/$name.json.tmp" 2> "artifacts/r4/$name.log"; then
+    grep "^{" "artifacts/r4/$name.json.tmp" | tail -1 > "artifacts/r4/$name.json"
+    rm -f "artifacts/r4/$name.json.tmp"
+    echo "    -> artifacts/r4/$name.json: $(cat artifacts/r4/$name.json)" >&2
+  else
+    echo "    FAILED (see artifacts/r4/$name.log)" >&2
+    mv "artifacts/r4/$name.json.tmp" "artifacts/r4/$name.failed" 2>/dev/null || true
+  fi
+}
+
+# 1) second baseline sample: the first one ate two contention stalls
+#    (windows 8310/1679 ms); a clean median pins the LN A/B denominator
+run bench_seq512_base2   python bench.py
+# 2) the per-kernel attention numbers the main capture lost to the
+#    non-JSON print
+run attn_bwd             python scripts/perf_attn_bwd.py
+# 3) the elementwise decomposition under the kept LN kernel — shows the
+#    bytes actually removed from the loop-fusion segment
+run elementwise_floor_lnfused python scripts/perf_elementwise_floor.py --ln_impl fused
+# 4) round-5 on-chip convergence re-proof (bert-tiny short proof: ~60 steps)
+run converge_tiny        python bench.py --mode converge --model bert-tiny \
+                           --converge_steps 60 --converge_lr 2e-3 \
+                           --converge_examples 2048 --converge_warmup 0.1
+# 5) the other model families under the kept LN kernel
+run bench_bert_large     python bench.py --model bert-large-uncased \
+                           --global_batch 256 --batch_split 4 --ln_impl fused
+run bench_roberta_large  python bench.py --model roberta-large \
+                           --global_batch 128 --batch_split 4 --ln_impl fused
+echo "=== extras complete" >&2

@@ -442,7 +442,7 @@ def test_flash_fwd_identical_with_and_without_lse():
             with_lse, lse = _flash_forward(
                 q, k, v, mask, seed, jnp.float32, 0.2, True, want_lse=True
             )
-            assert lse.shape == (B, H, L, 1)
+            assert lse.shape == (B, H, L)
         else:
             D = q.shape[-1]
             isz = q.dtype.itemsize
@@ -455,7 +455,7 @@ def test_flash_fwd_identical_with_and_without_lse():
                 q, k, v, mask, seed, *cfg, jnp.float32, 0.2, True,
                 want_lse=True,
             )
-            assert lse.shape == (B, H, L, 1)
+            assert lse.shape == (B, H, L)
         np.testing.assert_array_equal(np.asarray(plain), np.asarray(with_lse))
         # lse really is each row's logsumexp: exp(s - lse) rows sum to 1 on
         # valid rows — check via the XLA reference scores for one head
@@ -466,15 +466,15 @@ def test_flash_fwd_identical_with_and_without_lse():
         s[:, ~valid] = -1e30
         ref_lse = np.log(np.exp(s - s.max(-1, keepdims=True)).sum(-1)) + s.max(-1)
         np.testing.assert_allclose(
-            np.asarray(lse[0, 0, :, 0]), ref_lse, rtol=1e-4, atol=1e-4
+            np.asarray(lse[0, 0, :]), ref_lse, rtol=1e-4, atol=1e-4
         )
 
 
 @pytest.mark.unit
 def test_fused_bwd_accounting_no_excluded_terms():
     """VERDICT r3 #3: the fused-backward VMEM accounting counts EVERY block
-    (including the lane-padded lse input) against the measured ceiling, and
-    every shipped training geometry fits the budget at a pick no smaller
+    (including the sublane-padded lse input) against the measured ceiling,
+    and every shipped training geometry fits the budget at a pick no smaller
     than the round-3 measured ones (hc=6 for bert-base: the perf numbers
     were recorded there, so the honest accounting must not regress it)."""
     from ml_recipe_tpu.models import MODEL_PRESETS
@@ -486,13 +486,14 @@ def test_fused_bwd_accounting_no_excluded_terms():
         _pick_head_chunk,
     )
 
-    # the lse term is present: the helper must grow with the lane padding
-    # (7 in-dtype streams q k v g dq dk dv + the out stream at its own
+    # the lse term is present: the (1, 1, 1, hc*L) wire block is 8 sublanes
+    # x hc*L lanes of f32 in VMEM, double-buffered — exactly 2*8*L*4 per
+    # head (7 in-dtype streams q k v g dq dk dv + the out stream at its own
     # itemsize — mixed-precision out must not be undercounted)
     assert (
         _fused_bwd_bytes_per_head(512, 64, 2, 2)
         - 2 * 512 * 64 * 8 * 2
-        == 2 * 512 * 128 * 4
+        == 2 * 8 * 512 * 4
     )
     assert (
         _fused_bwd_bytes_per_head(512, 64, 2, 4)
@@ -601,9 +602,13 @@ def test_fused_bwd_hc_unclassified_error_falls_back_to_conservative(
     monkeypatch.setattr(fa.jax, "default_backend", lambda: "tpu")
     monkeypatch.setattr(fa, "_probe_results", {})
     # pin both budgets: the module-level ones are resolved from the
-    # environment/artifact at import time, and the (6, 4) picks below are
-    # only correct for the 15 MB-aggressive / 12 MB-conservative pair
-    monkeypatch.setattr(fa, "_VMEM_BUDGET_FUSED_BWD", 15 * 1024 * 1024)
+    # environment/artifact at import time, and the (12, 6) picks below are
+    # only correct for this 18 MB-aggressive / 12 MB-conservative pair
+    # (round 5: the compact [B, H, L] lse layout freed ~0.5 MB/head of
+    # accounting, so a 15 MB aggressive budget no longer picks above the
+    # conservative one at bert-base — the gap this test needs is recreated
+    # with a wider pinned pair)
+    monkeypatch.setattr(fa, "_VMEM_BUDGET_FUSED_BWD", 18 * 1024 * 1024)
     monkeypatch.setattr(fa, "_VMEM_BUDGET", 12 * 1024 * 1024)
 
     compiled = []
@@ -614,7 +619,7 @@ def test_fused_bwd_hc_unclassified_error_falls_back_to_conservative(
 
         def compile(self):
             compiled.append(self.hc)
-            if self.hc > 4:  # aggressive pick (hc=6) fails, wording unknown
+            if self.hc > 6:  # aggressive pick (hc=12) fails, wording unknown
                 raise RuntimeError(
                     "mosaic lowering error: some future overflow wording"
                 )
@@ -632,10 +637,11 @@ def test_fused_bwd_hc_unclassified_error_falls_back_to_conservative(
 
     hc = fa._fused_bwd_hc(4, 512, 12, 64, jnp.bfloat16, jnp.int32,
                           jnp.bfloat16, 0.1, interpret=False)
-    # bert-base L=512 bf16: aggressive budget picks 6, conservative 12 MB
-    # budget picks 4 — the fallback lands exactly there, not one step down
-    assert hc == 4
-    assert compiled == [6, 4]
+    # bert-base L=512 bf16: the pinned aggressive budget picks 12, the
+    # conservative 12 MB budget picks 6 — the fallback lands exactly there,
+    # not one step down
+    assert hc == 6
+    assert compiled == [12, 6]
 
 
 @pytest.mark.unit

@@ -351,7 +351,7 @@ def test_streaming_bf16_backward():
 
 def test_streaming_multihead_chunk_grads():
     """hc=4 (a multi-head chunk): the unrolled per-head lane slicing and
-    the [1, hc, blk, 1] lse indexing must hold at larger hc in all three
+    the [1, hc, blk] lse indexing must hold at larger hc in all three
     kernels. streaming_cfg legitimately prefers blk=512/hc=2 at these
     dims (bf16 at blk=256 picks hc=4 for real), so the kernels are driven
     directly at the (256, 4) geometry here."""
