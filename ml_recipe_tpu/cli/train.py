@@ -23,6 +23,7 @@ import functools
 import logging
 import os
 import signal
+import sys
 import threading
 from datetime import datetime
 
@@ -42,8 +43,45 @@ from ..utils.seed import set_seed
 logger = logging.getLogger(__name__)
 
 
+def _arm_watchdog(params):
+    """Install the process-global step watchdog from ``--watchdog_timeout``
+    (or CLEAR it when unset — a stale instance from a previous in-process
+    run must not keep governing barrier call sites). Must run BEFORE the
+    distributed rendezvous: a rendezvous that never completes is the
+    canonical startup hang the watchdog exists to catch."""
+    from ..resilience import watchdog as watchdog_mod
+
+    timeout = getattr(params, "watchdog_timeout", None)
+    return watchdog_mod.install(
+        watchdog_mod.Watchdog(timeout) if timeout else None
+    )
+
+
 def run_worker(params, model_params) -> None:
     """One SPMD host process (reference run_worker, train.py:18-122)."""
+    from ..resilience import watchdog as watchdog_mod
+
+    # Step watchdog: armed around every train/eval step and checkpoint
+    # barrier; a missed deadline dumps stacks and aborts with a distinct
+    # exit code so a supervisor restarts instead of the pod wedging.
+    # main() normally armed it before the rendezvous; arm here only for
+    # direct run_worker callers (embedding launchers) — and tear it down
+    # symmetrically, so a second config in the same process neither
+    # inherits a stale instance nor leaks monitor threads.
+    watchdog = watchdog_mod.current()
+    locally_armed = False
+    if watchdog is None and getattr(params, "watchdog_timeout", None):
+        watchdog = _arm_watchdog(params)
+        locally_armed = True
+    try:
+        _run_worker(params, model_params, watchdog)
+    finally:
+        if locally_armed:
+            watchdog.stop()
+            watchdog_mod.install(None)
+
+
+def _run_worker(params, model_params, watchdog) -> None:
     import jax
 
     log_file = params.log_file if is_primary() else None
@@ -111,6 +149,7 @@ def run_worker(params, model_params) -> None:
             params.dump_dir / f"board/{params.experiment_name}/trace"
             if getattr(params, "trace", False) else None
         ),
+        watchdog=watchdog,
     )
 
     if params.last is not None:
@@ -160,6 +199,13 @@ def run_worker(params, model_params) -> None:
             signal.signal(signal.SIGTERM, signal.SIG_IGN)
         local_logger.error("Training process was interrupted.")
         trainer.save_state_dict(params.dump_dir / params.experiment_name / "interrupt.ch")
+        # under a supervisor, a caught preemption is a reason to RESUME:
+        # exit with the tempfail code the supervisor classifies as
+        # 'preempted' (a bare return here would read as a clean finish)
+        if os.environ.get("MLRT_SUPERVISED"):
+            from ..resilience.supervisor import PREEMPT_EXIT_CODE
+
+            raise SystemExit(PREEMPT_EXIT_CODE)
     except Exception as e:
         local_logger.error(e)
         raise e
@@ -172,11 +218,25 @@ def main(params, model_params) -> None:
     show_params(model_params, "model")
     show_params(params, "trainer")
 
-    # Join the multi-host world BEFORE any jax device use (train.py:27-28's
-    # init_process_group, re-expressed as jax.distributed.initialize).
-    initialize_from_params(params)
+    # Arm the watchdog BEFORE joining the world: the rendezvous itself is
+    # the first thing that can hang (one host missing) and its watch frame
+    # only exists if the watchdog is already installed.
+    watchdog = _arm_watchdog(params)
 
-    run_worker(params, model_params)
+    try:
+        # Join the multi-host world BEFORE any jax device use (train.py:27-28's
+        # init_process_group, re-expressed as jax.distributed.initialize).
+        initialize_from_params(params)
+
+        run_worker(params, model_params)
+    finally:
+        # stop the monitor and clear the global slot so an embedding caller
+        # running several configs in one process never inherits a stale one
+        if watchdog is not None:
+            watchdog.stop()
+        from ..resilience import watchdog as watchdog_mod
+
+        watchdog_mod.install(None)
 
 
 def cli() -> None:
@@ -188,6 +248,22 @@ def cli() -> None:
     )
 
     os.makedirs(params.dump_dir / params.experiment_name, exist_ok=True)
+
+    # Fault drills: arm the configured plan in THIS process (children of the
+    # supervisor re-arm from their own argv/config/env).
+    if getattr(params, "fault_plan", None):
+        from ..resilience import faults
+
+        faults.install_plan(params.fault_plan)
+
+    # --supervise: this process becomes the supervisor; each attempt is a
+    # child running the same CLI minus the flag (MLRT_SUPERVISED breaks the
+    # recursion even when `supervise` comes from a config file) with --last
+    # re-pointed at the newest valid checkpoint.
+    if getattr(params, "supervise", False) and not os.environ.get("MLRT_SUPERVISED"):
+        from ..resilience.supervisor import supervise_cli
+
+        raise SystemExit(supervise_cli(params, sys.argv[1:]))
 
     params.log_file = (
         params.dump_dir / params.experiment_name

@@ -440,6 +440,36 @@ def get_trainer_parser() -> ConfigArgumentParser:
                         help="Device mesh axes, e.g. 'data:8' or 'data:4,model:2' or "
                              "'data:2,seq:4'. None = all devices on the data axis.")
 
+    # Fault tolerance (resilience/): supervised restart + watchdog + drills.
+    parser.add_argument("--supervise", action="store_true",
+                        help="Wrap the run in the auto-resume supervisor: "
+                             "restart on preemption/hang/crash with "
+                             "exponential backoff, resume from the newest "
+                             "valid checkpoint, abort on a crash-loop.")
+    parser.add_argument("--max_restarts", type=int, default=5,
+                        help="Supervisor: restarts after the first attempt.")
+    parser.add_argument("--backoff_base", type=float, default=1.0,
+                        help="Supervisor: seconds before the first restart "
+                             "(doubles per restart, seeded +-10%% jitter).")
+    parser.add_argument("--backoff_max", type=float, default=30.0,
+                        help="Supervisor: backoff ceiling in seconds.")
+    parser.add_argument("--crash_loop_window", type=int, default=3,
+                        help="Supervisor: abort with a diagnosis after this "
+                             "many consecutive failed attempts with no "
+                             "global_step progress.")
+    parser.add_argument("--watchdog_timeout", type=cast2(float), default=None,
+                        help="Seconds a train/eval step or checkpoint "
+                             "barrier may take before the watchdog dumps "
+                             "all-thread stacks and aborts for restart. "
+                             "None disables. Must comfortably exceed the "
+                             "first (compiling) step.")
+    parser.add_argument("--fault_plan", type=cast2(str), default=None,
+                        help="Fault-injection drill spec, e.g. "
+                             "'ckpt.pre_manifest:kill@2!once;"
+                             "loader.read:raise@1x3' "
+                             "(see resilience/faults.py for the grammar; "
+                             "also via $MLRT_FAULTS).")
+
     parser.add_argument("--best_metric", choices=["map"], type=str, default="map",
                         help="Best metric name.")
     parser.add_argument("--best_order", choices=[">", "<"], type=str, default=">",

@@ -19,12 +19,39 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Iterator, Optional, Sequence
 
 import numpy as np
 
+from ..resilience.faults import fire as _fault
+from ..resilience.faults import retry_transient
+
 logger = logging.getLogger(__name__)
+
+
+class DataLoaderWorkerError(RuntimeError):
+    """An async loader worker died. Carries the worker's formatted traceback
+    in the message (and chains the original via ``__cause__``): the
+    consumer raises on ITS thread, and without this the only record of
+    where the worker actually failed would be lost across the queue."""
+
+
+def _read_with_retry(dataset, index: int, *, retries: int):
+    """One dataset item read, with bounded retry + backoff on transient
+    ``OSError`` (flaky network FS, evicted page cache, injected drills).
+    Non-OSError failures (bugs) propagate immediately — retrying those only
+    delays the real traceback."""
+
+    def read():
+        _fault("loader.read")
+        return dataset[index]
+
+    return retry_transient(
+        read, retries=retries, exceptions=(OSError,),
+        what=f"dataset read [{index}]",
+    )
 
 
 class ShardedBatchSampler:
@@ -117,12 +144,14 @@ class DataLoader:
         *,
         n_jobs: int = 4,
         prefetch: int = 4,
+        read_retries: int = 3,
     ):
         self.dataset = dataset
         self.sampler = sampler
         self.collate_fun = collate_fun
         self.n_jobs = max(1, n_jobs)
         self.prefetch = max(1, prefetch)
+        self.read_retries = max(0, read_retries)
         self._epoch = 0
 
     def set_epoch(self, epoch: int) -> None:
@@ -132,7 +161,10 @@ class DataLoader:
         return len(self.sampler)
 
     def _load_batch(self, batch_indices: np.ndarray):
-        items = [self.dataset[int(i)] for i in batch_indices]
+        items = [
+            _read_with_retry(self.dataset, int(i), retries=self.read_retries)
+            for i in batch_indices
+        ]
         return self.collate_fun(items)
 
     def __iter__(self):
@@ -177,6 +209,7 @@ class ListDataloader:
         buffer_size: int = 1024,
         shuffle: bool = False,
         seed: int = 0,
+        read_retries: int = 3,
     ):
         self.dataset = dataset
         self.batch_size = batch_size
@@ -185,6 +218,7 @@ class ListDataloader:
         self.buffer_size = buffer_size
         self.shuffle = shuffle
         self.seed = seed
+        self.read_retries = max(0, read_retries)
 
     def process_batch(self, batch):
         return self.collate_fun(batch) if self.collate_fun is not None else batch
@@ -198,15 +232,21 @@ class ListDataloader:
         errors: list = []
         done = threading.Event()
 
+        def read(i: int):
+            return _read_with_retry(self.dataset, i, retries=self.read_retries)
+
         def producer():
             try:
                 with ThreadPoolExecutor(max_workers=self.n_jobs) as pool:
-                    for chunks in pool.map(self.dataset.__getitem__, [int(i) for i in idxs]):
+                    for chunks in pool.map(read, [int(i) for i in idxs]):
                         for chunk in chunks:
                             q.put(chunk)
             except Exception as e:  # surface worker errors to the consumer
-                logger.error(e)
-                errors.append(e)
+                # capture the traceback HERE: the exception is re-raised on
+                # the consumer thread, where this stack no longer exists
+                tb = traceback.format_exc()
+                logger.error(f"ListDataloader worker failed:\n{tb}")
+                errors.append((e, tb))
             finally:
                 done.set()
                 q.put(self._SENTINEL)
@@ -225,7 +265,11 @@ class ListDataloader:
                 batch = []
 
         if errors:
-            raise errors[0]
+            e, tb = errors[0]
+            raise DataLoaderWorkerError(
+                f"async loader worker failed: {e!r}\n"
+                f"--- worker traceback ---\n{tb}"
+            ) from e
 
         if batch:
             yield self.process_batch(batch)

@@ -22,7 +22,9 @@ from __future__ import annotations
 
 import logging
 import queue
+import sys
 import threading
+import traceback
 from collections import defaultdict
 from dataclasses import dataclass
 from typing import Optional
@@ -41,6 +43,39 @@ try:  # pragma: no cover - cosmetic only
     from tqdm.auto import tqdm
 except Exception:  # noqa: BLE001
     tqdm = None
+
+
+class WorkerShutdownError(RuntimeError):
+    """The transfer worker was still alive after the join timeout: something
+    it blocks on (a device transfer, the upstream loader) is wedged. Raised
+    so the hang is VISIBLE at the call site instead of leaking a zombie
+    daemon thread that silently pins the device."""
+
+
+def _ensure_worker_stopped(
+    worker: threading.Thread, *, timeout: float = 10.0
+) -> None:
+    """Join ``worker``; on timeout, log its current stack (the only clue to
+    WHAT it is stuck on) and raise — unless an exception is already
+    propagating, in which case only warn: the original error is the story,
+    and replacing it with a shutdown complaint would hide it."""
+    worker.join(timeout=timeout)
+    if not worker.is_alive():
+        return
+    frame = sys._current_frames().get(worker.ident)
+    stack = (
+        "".join(traceback.format_stack(frame)) if frame is not None
+        else "<no frame available>"
+    )
+    logger.warning(
+        f"Worker thread {worker.name!r} still alive {timeout:g}s after "
+        f"shutdown was requested; its stack:\n{stack}"
+    )
+    if sys.exc_info()[0] is None:
+        raise WorkerShutdownError(
+            f"worker thread {worker.name!r} failed to stop within "
+            f"{timeout:g}s (stack logged above)"
+        )
 
 
 @dataclass
@@ -400,7 +435,7 @@ class Predictor:
                         stage.get_nowait()
                     except queue.Empty:
                         break
-                worker.join(timeout=10)
+                _ensure_worker_stopped(worker, timeout=10)
 
         return self
 

@@ -17,6 +17,10 @@ from typing import Optional
 
 import jax
 
+from ..resilience.faults import fire as _fault
+from ..resilience.watchdog import current as _current_watchdog
+from ..resilience.watchdog import watched as _watched
+
 logger = logging.getLogger(__name__)
 
 _initialized = False
@@ -46,11 +50,24 @@ def initialize_distributed(
     logger.warning(
         "Waiting for every worker to reach the coordinator; startup may be slow."
     )
-    jax.distributed.initialize(
-        coordinator_address=address,
-        num_processes=num_processes,
-        process_id=process_id,
-    )
+    # drill site: a rendezvous that never completes (one host missing) is
+    # the canonical multi-node startup failure — injectable as stall/raise.
+    # Fired INSIDE the watch frame so an injected stall exercises the same
+    # watchdog path the real hang would take. The frame gets 8x the
+    # step-scale timeout (like checkpoint saves): a pod cold start
+    # legitimately waits minutes for the slowest host's container, and a
+    # slow-but-healthy startup must not be escalated into a crash-loop.
+    _wd = _current_watchdog()
+    with _watched(
+        f"distributed rendezvous {address}",
+        _wd.timeout * 8 if _wd is not None else None,
+    ):
+        _fault("dist.rendezvous")
+        jax.distributed.initialize(
+            coordinator_address=address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
     _initialized = True
     logger.info(
         f"Joined distributed world: process {process_id}/{num_processes}, "
@@ -101,12 +118,21 @@ def is_primary() -> bool:
 
 
 def barrier(name: str = "barrier") -> None:
-    """Block until every process reaches this point (train.py:55 parity)."""
-    if jax.process_count() == 1:
-        return
-    from jax.experimental import multihost_utils
+    """Block until every process reaches this point (train.py:55 parity).
 
-    multihost_utils.sync_global_devices(name)
+    The fault site fires BEFORE the single-process early return so barrier
+    stall/kill drills work under ``JAX_PLATFORMS=cpu`` test worlds too, and
+    INSIDE the watch frame so an injected stall takes the same watchdog
+    path a peer-never-arrives hang would: stack dump + abort + supervised
+    restart instead of an indefinitely wedged pod.
+    """
+    with _watched(f"barrier:{name}"):
+        _fault("dist.barrier")
+        if jax.process_count() == 1:
+            return
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
 
 
 # -- native host-coordination helper (native/coord) ---------------------------

@@ -1,0 +1,51 @@
+"""Fault-tolerance subsystem: supervised restart, step watchdog, fault drills.
+
+Production multi-node training stands on three cooperating layers
+(TorchTitan, arXiv:2410.06511; TPUv4 pjit ops report, arXiv:2204.06514 —
+preemption is the steady state at pod scale, not the exception):
+
+- :mod:`.supervisor` — wraps the training entrypoint in a bounded-retry
+  loop: classifies exits (clean / preempted / hang / crash), auto-resumes
+  from the newest valid checkpoint, detects crash-loops (no ``global_step``
+  progress across K attempts) and aborts with a diagnosis instead of
+  burning the retry budget.
+- :mod:`.watchdog` — a heartbeat thread armed around every train/eval step
+  and checkpoint barrier; a missed deadline (hung collective, stuck host)
+  dumps all-thread stacks and aborts the process with a distinct exit code
+  so the supervisor restarts instead of wedging the pod.
+- :mod:`.faults` — a deterministic, env/config-driven fault-injection
+  registry with named sites threaded through the checkpoint writer, the
+  data loaders, and the distributed barriers, so every recovery path is
+  testable (and drillable in production) under ``JAX_PLATFORMS=cpu``.
+
+The passive pieces (atomic/sharded checkpoints with torn-save recovery,
+SIGTERM-to-checkpoint) live in :mod:`..train.checkpoint` and
+:mod:`..cli.train`; this package is the active layer that detects failure,
+restarts, and proves the recovery paths work.
+"""
+
+from .faults import FaultError, FaultPlan, fire, install_plan
+from .supervisor import (
+    PREEMPT_EXIT_CODE,
+    Attempt,
+    RetryPolicy,
+    Supervisor,
+    SupervisorResult,
+    classify_exit,
+)
+from .watchdog import WATCHDOG_EXIT_CODE, Watchdog
+
+__all__ = [
+    "Attempt",
+    "FaultError",
+    "FaultPlan",
+    "PREEMPT_EXIT_CODE",
+    "RetryPolicy",
+    "Supervisor",
+    "SupervisorResult",
+    "WATCHDOG_EXIT_CODE",
+    "Watchdog",
+    "classify_exit",
+    "fire",
+    "install_plan",
+]

@@ -1,0 +1,402 @@
+"""Auto-resume supervisor: the local analogue of an elastic agent.
+
+Wraps the training entrypoint in a bounded-retry loop (``--supervise`` on
+the train CLI). Each attempt is a child process; on exit the supervisor
+
+1. classifies the exit — clean / preempted / hang (watchdog abort) /
+   crash — from the return code,
+2. measures progress by peeking ``global_step`` out of the newest on-disk
+   checkpoint (no cooperation from the child needed: a hard-killed child
+   reports through what it durably saved, which is the only truth anyway),
+3. restarts with ``--last <newest checkpoint>`` after an exponential
+   backoff with seeded jitter (deterministic: drills replay identically),
+4. aborts with a diagnosis once ``crash_loop_window`` consecutive failed
+   attempts made NO checkpoint progress — a crash-loop restarted forever
+   is strictly worse than a loud early exit with the failure classified.
+
+The supervisor deliberately knows nothing about JAX: it manages a process
+and a checkpoint directory. That is what lets the chaos suite drive real
+kill/stall scenarios through it in milliseconds-per-decision on CPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+from typing import Callable, List, Optional, Sequence
+
+from .watchdog import WATCHDOG_EXIT_CODE
+
+logger = logging.getLogger(__name__)
+
+# A supervised child that caught SIGTERM/SIGINT, saved interrupt.ch and
+# unwound cleanly exits with this (EX_TEMPFAIL) instead of 0, so the
+# supervisor restarts it — a preemption is a reason to resume, not to stop.
+PREEMPT_EXIT_CODE = 75
+
+CLEAN = "clean"
+PREEMPTED = "preempted"
+HANG = "hang"
+CRASH = "crash"
+
+# exits worth retrying; CLEAN ends the loop, anything unknown is a crash
+_RETRYABLE = (PREEMPTED, HANG, CRASH)
+
+
+def classify_exit(returncode: int) -> str:
+    """Map a child return code onto an exit class."""
+    if returncode == 0:
+        return CLEAN
+    if returncode == WATCHDOG_EXIT_CODE:
+        return HANG
+    if returncode == PREEMPT_EXIT_CODE:
+        return PREEMPTED
+    # Popen reports death-by-signal as -signum; platform evictions that
+    # skip our SIGTERM hook surface as SIGKILL/SIGTERM here. 128+signum
+    # covers shells that re-encode it. An injected drill kill
+    # (KILL_EXIT_CODE) stays a crash: mid-write kills are the scenario
+    # being tested, not an infra event to blame.
+    for sig in (signal.SIGTERM, signal.SIGKILL, signal.SIGHUP):
+        if returncode in (-int(sig), 128 + int(sig)):
+            return PREEMPTED
+    return CRASH
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    # Restarts chargeable AFTER the first attempt. Only failures WITHOUT
+    # checkpoint progress consume the budget: on preemptible pools a
+    # healthy multi-day run is preempted far more than any fixed budget,
+    # and a preemption that resumed and advanced global_step is the system
+    # WORKING, not failing. Pathological progress-making crash cycles are
+    # still bounded by the crash-loop detector the moment progress stops.
+    max_restarts: int = 5
+    backoff_base: float = 1.0      # seconds before restart #1
+    backoff_factor: float = 2.0
+    backoff_max: float = 30.0
+    jitter: float = 0.1            # +-10% seeded jitter (thundering herd)
+    crash_loop_window: int = 3     # consecutive no-progress failures -> abort
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class Attempt:
+    index: int
+    returncode: int
+    outcome: str
+    step_before: Optional[int]
+    step_after: Optional[int]
+    backoff: float = 0.0           # sleep AFTER this attempt (0 = none)
+
+    @property
+    def progressed(self) -> bool:
+        if self.step_after is None:
+            return False
+        return self.step_before is None or self.step_after > self.step_before
+
+
+@dataclasses.dataclass
+class SupervisorResult:
+    status: str        # 'clean' | 'crash-loop' | 'retries-exhausted' | 'terminated'
+    attempts: List[Attempt]
+    diagnosis: str = ""
+    signum: Optional[int] = None   # set when status == 'terminated'
+
+    @property
+    def exit_code(self) -> int:
+        if self.signum is not None:
+            return 128 + int(self.signum)  # shell convention: died by signal
+        return {"clean": 0, "crash-loop": 1}.get(self.status, 2)
+
+    def outcomes(self) -> List[str]:
+        return [a.outcome for a in self.attempts]
+
+
+class Supervisor:
+    """Bounded-retry loop around a launchable child.
+
+    ``launch(attempt_index)`` returns either a ``Popen``-like object (with
+    ``wait``/``kill``) or a bare int return code (tests). ``progress()``
+    returns the newest durable ``global_step`` (or None) — called before
+    and after every attempt. ``sleep`` is injectable so drills don't
+    actually wait out the backoff.
+    """
+
+    def __init__(
+        self,
+        launch: Callable[[int], object],
+        *,
+        progress: Callable[[], Optional[int]],
+        policy: Optional[RetryPolicy] = None,
+        attempt_timeout: Optional[float] = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.launch = launch
+        self.progress = progress
+        self.policy = policy or RetryPolicy()
+        self.attempt_timeout = attempt_timeout
+        self.sleep = sleep
+        self._rng = random.Random(self.policy.seed)
+        self._child = None
+        self._terminate_signum: Optional[int] = None
+
+    # -- supervisor-level signals ----------------------------------------------
+
+    def _forward_signal(self, signum, frame) -> None:
+        """SIGTERM/SIGINT on the SUPERVISOR: forward to the live child (so
+        it takes its own save-and-exit path) and stop supervising after it
+        exits — never orphan a training process that would race the next
+        submission's child on the checkpoint directory."""
+        self._terminate_signum = int(signum)
+        child = self._child
+        if child is not None and hasattr(child, "send_signal"):
+            try:
+                child.send_signal(signum)
+            except OSError:  # child already gone
+                pass
+
+    def _install_signal_handlers(self):
+        import threading
+
+        if threading.current_thread() is not threading.main_thread():
+            return None  # signal.signal raises off the main thread
+        prev = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            prev[sig] = signal.signal(sig, self._forward_signal)
+        return prev
+
+    # -- one attempt -----------------------------------------------------------
+
+    def _wait(self, child) -> int:
+        if isinstance(child, int):
+            return child
+        try:
+            return child.wait(timeout=self.attempt_timeout)
+        except subprocess.TimeoutExpired:
+            # supervisor-side wall clock tripped: the child has no (working)
+            # watchdog — kill it and classify as a hang ourselves
+            logger.error(
+                f"Attempt exceeded the {self.attempt_timeout:g}s wall clock; "
+                f"killing the child."
+            )
+            child.kill()
+            child.wait()
+            return WATCHDOG_EXIT_CODE
+
+    def _backoff(self, no_progress_streak: int) -> float:
+        """Backoff grows with CONSECUTIVE no-progress failures (a persistent
+        fault deserves widening gaps); a restart after a progressing
+        failure — a resumed preemption — waits only the base."""
+        p = self.policy
+        base = min(
+            p.backoff_base * (p.backoff_factor ** max(no_progress_streak - 1, 0)),
+            p.backoff_max,
+        )
+        return base * (1.0 + p.jitter * self._rng.uniform(-1.0, 1.0))
+
+    # -- the loop --------------------------------------------------------------
+
+    def run(self) -> SupervisorResult:
+        prev_handlers = self._install_signal_handlers()
+        try:
+            return self._run()
+        finally:
+            if prev_handlers:
+                for sig, handler in prev_handlers.items():
+                    signal.signal(sig, handler)
+
+    def _run(self) -> SupervisorResult:
+        p = self.policy
+        attempts: List[Attempt] = []
+        no_progress_streak = 0
+        restarts_used = 0  # only no-progress failures consume the budget
+
+        def terminated(step) -> SupervisorResult:
+            diagnosis = (
+                f"SUPERVISOR: terminated by signal {self._terminate_signum} "
+                f"(checkpoint step {step}); standing down without restart."
+            )
+            logger.error(diagnosis)
+            sys.stderr.write(diagnosis + "\n")
+            sys.stderr.flush()
+            return SupervisorResult(
+                "terminated", attempts, diagnosis, signum=self._terminate_signum
+            )
+
+        attempt_i = 0
+        while True:
+            step_before = self.progress()
+            if self._terminate_signum is not None:
+                # signal arrived between attempts (e.g. during backoff):
+                # do not launch another child
+                return terminated(step_before)
+            logger.warning(
+                f"SUPERVISOR: attempt {attempt_i + 1} (restart budget "
+                f"{restarts_used}/{p.max_restarts} used; resume step: "
+                f"{step_before if step_before is not None else 'fresh'})."
+            )
+            self._child = self.launch(attempt_i)
+            try:
+                rc = self._wait(self._child)
+            finally:
+                self._child = None
+            outcome = classify_exit(rc)
+            step_after = self.progress()
+            attempt = Attempt(attempt_i, rc, outcome, step_before, step_after)
+            attempts.append(attempt)
+            attempt_i += 1
+
+            if outcome == CLEAN:
+                logger.warning(
+                    f"SUPERVISOR: clean exit after {len(attempts)} attempt(s) "
+                    f"(final step: {step_after})."
+                )
+                return SupervisorResult(CLEAN, attempts)
+
+            if self._terminate_signum is not None:
+                # the supervisor itself was told to stop; the child already
+                # received the forwarded signal and has now exited — report
+                # and stand down instead of restarting
+                return terminated(step_after)
+
+            if attempt.progressed:
+                no_progress_streak = 0
+            else:
+                no_progress_streak += 1
+                restarts_used += 1
+            logger.error(
+                f"SUPERVISOR: attempt {attempt_i} exited {rc} "
+                f"[{outcome}]; checkpoint step {step_before} -> {step_after} "
+                f"({'progress' if attempt.progressed else 'NO progress'}, "
+                f"streak {no_progress_streak}/{p.crash_loop_window})."
+            )
+
+            if no_progress_streak >= p.crash_loop_window:
+                diagnosis = (
+                    f"SUPERVISOR: crash-loop: no global_step progress across "
+                    f"{no_progress_streak} consecutive failed attempts "
+                    f"(last exit {rc} [{outcome}], stuck at step "
+                    f"{step_after if step_after is not None else 'none'}); "
+                    f"aborting — restarting further would burn the retry "
+                    f"budget without converging."
+                )
+                logger.error(diagnosis)
+                sys.stderr.write(diagnosis + "\n")
+                sys.stderr.flush()
+                return SupervisorResult("crash-loop", attempts, diagnosis)
+
+            if restarts_used > p.max_restarts:
+                break
+            attempt.backoff = self._backoff(no_progress_streak)
+            logger.warning(
+                f"SUPERVISOR: restarting [{outcome}] in {attempt.backoff:.2f}s."
+            )
+            self.sleep(attempt.backoff)
+
+        diagnosis = (
+            f"SUPERVISOR: retry budget exhausted after "
+            f"{len(attempts)} attempts (outcomes: "
+            f"{', '.join(a.outcome for a in attempts)})."
+        )
+        logger.error(diagnosis)
+        sys.stderr.write(diagnosis + "\n")
+        sys.stderr.flush()
+        return SupervisorResult("retries-exhausted", attempts, diagnosis)
+
+
+# -- checkpoint progress probing ----------------------------------------------
+
+
+def newest_checkpoint(candidates: Sequence) -> tuple:
+    """``(path, step)`` of the candidate with the highest peekable
+    ``global_step`` (``(None, None)`` when none is loadable). Imports the
+    checkpoint module lazily: the supervisor itself must not pay (or
+    depend on) the jax import."""
+    from ..train.checkpoint import peek_global_step
+
+    best, best_step = None, None
+    for cand in candidates:
+        step = peek_global_step(cand)
+        if step is not None and (best_step is None or step > best_step):
+            best, best_step = cand, step
+    return best, best_step
+
+
+# -- CLI wiring ----------------------------------------------------------------
+
+# Set in every supervised child: (a) lets the train CLI turn a caught
+# preemption into PREEMPT_EXIT_CODE, (b) breaks --supervise recursion even
+# when the flag comes from a config file the child re-reads.
+SUPERVISED_ENV = "MLRT_SUPERVISED"
+
+
+def build_child_argv(
+    argv: Sequence[str], *, resume: Optional[str] = None
+) -> List[str]:
+    """Strip supervisor-only flags from ``argv`` and re-point ``--last``."""
+    out: List[str] = []
+    skip_value = False
+    for arg in argv:
+        if skip_value:
+            skip_value = False
+            continue
+        if arg == "--supervise" or arg.startswith("--supervise="):
+            continue
+        if resume is not None:
+            if arg == "--last":
+                skip_value = True
+                continue
+            if arg.startswith("--last="):
+                continue
+        out.append(arg)
+    if resume is not None:
+        out.extend(["--last", resume])
+    return out
+
+
+def supervise_cli(params, argv: Sequence[str]) -> int:
+    """Drive ``python -m ml_recipe_tpu.cli.train`` under supervision.
+
+    Resumes each attempt from the newest of ``interrupt.ch`` / ``last.ch``
+    in the experiment directory (emergency checkpoints win when they are
+    ahead, which they are after a mid-epoch preemption).
+    """
+    exp_dir = os.path.join(os.fspath(params.dump_dir), params.experiment_name)
+    candidates = [
+        os.path.join(exp_dir, "interrupt.ch"),
+        os.path.join(exp_dir, "last.ch"),
+    ]
+
+    def progress() -> Optional[int]:
+        return newest_checkpoint(candidates)[1]
+
+    def launch(attempt_i: int):
+        resume, step = newest_checkpoint(candidates)
+        child_argv = build_child_argv(argv, resume=resume)
+        env = dict(os.environ)
+        env[SUPERVISED_ENV] = "1"
+        logger.warning(
+            f"SUPERVISOR: launching attempt {attempt_i + 1}"
+            + (f" resuming {resume} (step {step})" if resume else " fresh")
+            + "."
+        )
+        return subprocess.Popen(
+            [sys.executable, "-m", "ml_recipe_tpu.cli.train", *child_argv],
+            env=env,
+        )
+
+    policy = RetryPolicy(
+        max_restarts=getattr(params, "max_restarts", 5),
+        backoff_base=getattr(params, "backoff_base", 1.0),
+        backoff_max=getattr(params, "backoff_max", 30.0),
+        crash_loop_window=getattr(params, "crash_loop_window", 3),
+        seed=getattr(params, "seed", None) or 0,
+    )
+    result = Supervisor(launch, progress=progress, policy=policy).run()
+    return result.exit_code

@@ -1,7 +1,12 @@
 from .optim import build_optimizer, adamod, linear_warmup_schedule
 from .trainer import Trainer
 from .callback import TestCallback, AccuracyCallback, MAPCallback, SaveBestCallback
-from .checkpoint import save_state_dict, load_state_dict
+from .checkpoint import (
+    TornCheckpointError,
+    load_state_dict,
+    peek_global_step,
+    save_state_dict,
+)
 from .writer import SummaryWriter, init_writer
 
 __all__ = [
@@ -15,6 +20,8 @@ __all__ = [
     "SaveBestCallback",
     "save_state_dict",
     "load_state_dict",
+    "peek_global_step",
+    "TornCheckpointError",
     "SummaryWriter",
     "init_writer",
 ]

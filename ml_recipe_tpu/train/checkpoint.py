@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import logging
 import os
+import zlib
 from typing import Any, Optional
 
 import numpy as np
@@ -29,6 +30,7 @@ from flax.traverse_util import empty_node, flatten_dict, unflatten_dict
 
 from ..parallel.sharding import gather_to_host as _to_host
 from ..parallel.sharding import needs_collective_gather
+from ..resilience.faults import fire as _fault
 
 logger = logging.getLogger(__name__)
 
@@ -99,6 +101,7 @@ def save_state_dict(
             )
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     # atomic: no torn checkpoints on interrupt
+    _fault("ckpt.pre_write")
     _atomic_write(path, serialization.msgpack_serialize(state))
     logger.info(f"State dict was saved to {path}.")
 
@@ -148,6 +151,59 @@ def _recover_interrupted_swap(path: str, staging: str, old: str) -> None:
     except OSError:  # lost a recovery race?
         if not os.path.exists(path):
             raise
+
+
+def _crc32_of(arr) -> int:
+    """crc32 over an array's raw bytes (C-contiguous, so the checksum is a
+    pure function of values+shape+dtype, not of the source's strides)."""
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes())
+
+
+def _norm_bounds(bounds) -> list:
+    return [[int(a), int(b)] for a, b in bounds]
+
+
+def _fold_piece_crcs(pieces) -> int:
+    """Combine per-piece crcs into one leaf checksum: fold ``(bounds, crc)``
+    records in deterministic (sorted-bounds) order. Detects a swapped or
+    bit-rotted piece AND a hand-assembled directory whose pieces disagree
+    with what the manifest's writer saved — without ever needing the full
+    leaf in one buffer."""
+    crc = 0
+    for bounds, piece_crc in sorted(
+        (tuple(map(tuple, _norm_bounds(b))), int(c)) for b, c in pieces
+    ):
+        crc = zlib.crc32(repr((bounds, piece_crc)).encode(), crc)
+    return crc
+
+
+def peek_global_step(path) -> Optional[int]:
+    """``global_step`` of the checkpoint at ``path`` without restoring any
+    state, or None when there is no readable checkpoint there. The
+    supervisor's progress probe: called between restart attempts, so it
+    rolls an interrupted swap forward/back first (same as a load would)
+    and treats ANY unreadable/torn checkpoint as absent rather than
+    raising — an unreadable checkpoint cannot be resumed from, which is
+    exactly what None means."""
+    path = os.fspath(path)
+    if not os.path.exists(path):
+        _recover_interrupted_swap(path, path + ".saving", path + ".old")
+    if not os.path.exists(path):
+        return None
+    try:
+        if os.path.isdir(path):
+            manifest_path = os.path.join(path, _MANIFEST)
+            if not os.path.exists(manifest_path):
+                return None
+            with open(manifest_path, "rb") as fh:
+                manifest = serialization.msgpack_restore(fh.read())
+            return int(manifest["global_step"])
+        with open(path, "rb") as fh:
+            state = serialization.msgpack_restore(fh.read())
+        return int(state.get("global_step", 0))
+    except Exception as e:  # noqa: BLE001 - torn/corrupt == not resumable
+        logger.warning(f"Could not peek global_step from {path}: {e!r}")
+        return None
 
 
 def _flat_state(tree) -> dict:
@@ -271,21 +327,43 @@ def save_state_dict_sharded(
                         [int(s.start or 0), int(s.stop if s.stop is not None else dim)]
                         for s, dim in zip(shard.index, arr.shape)
                     ]
+                    data = np.asarray(shard.data)
                     group_out.setdefault(key, []).append(
-                        {"bounds": bounds, "data": np.asarray(shard.data)}
+                        {"bounds": bounds, "data": data, "crc32": _crc32_of(data)}
                     )
             elif jax.process_index() == 0:
                 # host (numpy/python) leaf: replicated by construction,
                 # the primary owns it
                 a = np.asarray(arr)
                 group_out.setdefault(key, []).append(
-                    {"bounds": [[0, d] for d in a.shape], "data": a}
+                    {"bounds": [[0, d] for d in a.shape], "data": a,
+                     "crc32": _crc32_of(a)}
+                )
+            # Leaf-level checksum in the manifest whenever THIS process's
+            # owned pieces tile the whole leaf (always true single-process;
+            # multi-host leaves with remote-owned pieces rely on the
+            # per-piece crcs alone — the manifest writer cannot know remote
+            # bytes without the gather this save path exists to avoid).
+            pieces = group_out.get(key, [])
+            covered = sum(
+                int(np.prod([b - a for a, b in p["bounds"]], dtype=np.int64))
+                if p["bounds"] else 1
+                for p in pieces
+            )
+            want = (
+                int(np.prod(leaves_meta[key]["shape"], dtype=np.int64))
+                if leaves_meta[key]["shape"] else 1
+            )
+            if pieces and covered == want:
+                leaves_meta[key]["crc32"] = _fold_piece_crcs(
+                    [(p["bounds"], p["crc32"]) for p in pieces]
                 )
         manifest["groups"][gname] = leaves_meta
 
     # each shard file still carries the step as defense-in-depth torn-save
     # detection (e.g. a checkpoint directory assembled by hand)
     shard_file = os.path.join(staging, f"shard-{jax.process_index():05d}.msgpack")
+    _fault("ckpt.pre_shard_write")
     _atomic_write(
         shard_file,
         serialization.msgpack_serialize(
@@ -297,6 +375,10 @@ def save_state_dict_sharded(
     if jax.process_index() == 0:
         import shutil
 
+        # the chaos suite's canonical kill window: shards durable, manifest
+        # (= completeness marker) not yet — the previous checkpoint at
+        # `path` must survive untouched
+        _fault("ckpt.pre_manifest")
         _atomic_write(
             os.path.join(staging, _MANIFEST),
             serialization.msgpack_serialize(manifest),
@@ -309,6 +391,7 @@ def save_state_dict_sharded(
             os.replace(path, old)
         elif os.path.isdir(path):
             os.rename(path, old)
+        _fault("ckpt.mid_swap")
         os.rename(staging, path)
         if os.path.isdir(old):
             shutil.rmtree(old)
@@ -357,6 +440,7 @@ def load_state_dict_sharded(
 
     assembled: dict = {g: {} for g in manifest["groups"]}
     filled: dict = {g: {} for g in manifest["groups"]}
+    piece_crcs: dict = {g: {} for g in manifest["groups"]}
     for f in shard_files:
         with open(f, "rb") as fh:
             data = serialization.msgpack_restore(fh.read())
@@ -380,6 +464,19 @@ def load_state_dict_sharded(
                     assembled[gname][key] = buf
                     filled[gname][key] = 0
                 for sh in shards:
+                    # per-piece bit-rot detection: the checksum travelled
+                    # with the bytes, so a flipped bit anywhere in the
+                    # stored piece fails loudly here instead of training on
+                    if "crc32" in sh and _crc32_of(sh["data"]) != int(sh["crc32"]):
+                        raise TornCheckpointError(
+                            f"sharded checkpoint corrupt: {gname}/{key} piece "
+                            f"{_norm_bounds(sh['bounds'])} in {f} fails its "
+                            f"crc32 check (bit rot or a damaged shard file)"
+                        )
+                    if "crc32" in sh:
+                        piece_crcs[gname].setdefault(key, []).append(
+                            (sh["bounds"], sh["crc32"])
+                        )
                     idx = tuple(slice(a, b) for a, b in sh["bounds"])
                     buf[idx] = sh["data"]
                     filled[gname][key] += int(np.prod(
@@ -396,6 +493,20 @@ def load_state_dict_sharded(
                     f"sharded checkpoint incomplete: {gname}/{key} has {got} "
                     f"of {want} elements (missing shard files?)"
                 )
+            # leaf-level check against the MANIFEST (written by the save
+            # that produced the pieces): catches a hand-assembled directory
+            # whose shard files are internally consistent but belong to a
+            # different save than the manifest — beyond the step check,
+            # which such a mix can pass
+            if "crc32" in meta:
+                folded = _fold_piece_crcs(piece_crcs[gname].get(key, []))
+                if folded != int(meta["crc32"]):
+                    raise TornCheckpointError(
+                        f"sharded checkpoint corrupt: {gname}/{key} piece "
+                        f"checksums do not match the manifest (shard files "
+                        f"from a different save assembled under this "
+                        f"manifest?)"
+                    )
 
     def _restore(target, gname):
         flat = dict(assembled[gname])

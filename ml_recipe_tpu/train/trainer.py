@@ -31,6 +31,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 from collections import defaultdict
+from contextlib import nullcontext
 from typing import Any, Optional
 
 import jax
@@ -40,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..data.loader import DataLoader, ShardedBatchSampler
 from ..metrics import AverageMeter
+from ..resilience.faults import fire as _fault
 from ..parallel import build_mesh, gather_to_host, make_global_array, shard_params
 from ..parallel.sharding import is_single_device
 from ..utils.pipeline import LaggedConsumer
@@ -130,6 +132,12 @@ class Trainer:
     # (the supported way to capture a loss curve — bench --mode converge and
     # the convergence test use it; the TB writer is unaffected).
     on_train_metrics: Any = None
+
+    # Optional resilience.Watchdog: armed around every train/eval step and
+    # checkpoint save so a hung collective / stuck host aborts the process
+    # (with stacks dumped) for the supervisor to restart, instead of
+    # wedging. None = zero overhead.
+    watchdog: Any = None
 
     def __post_init__(self):
         if self.mesh is None:
@@ -317,6 +325,17 @@ class Trainer:
         if use_zero:
             logger.info("ZeRO-1: optimizer state sharded over the data axis.")
         self._bundle_ls()
+
+    def _watched(self, label: str, *, scale: float = 1.0):
+        """Watchdog frame around a unit of host-side work, yielding a
+        per-step ``tick`` (re-entrant: checkpoint barriers arm their own
+        frame on top). ``scale`` multiplies the configured timeout for
+        units that are legitimately slower than a step. No-op context
+        without a watchdog."""
+        if self.watchdog is None:
+            return nullcontext(lambda *_: None)
+        timeout = self.watchdog.timeout * scale if scale != 1.0 else None
+        return self.watchdog.watch(label, timeout)
 
     # -- batch placement ------------------------------------------------------
 
@@ -630,35 +649,43 @@ class Trainer:
         # fetch step N-1's scalars while N runs. Without this the per-step
         # device_get serializes device compute with host batch prep.
         lag = LaggedConsumer(consume, total=len(self.train_dataloader))
-        for step_i, (inputs, labels) in enumerate(iterator):
-            if not trace_started and epoch_i == 1 and step_i == trace_from:
-                jax.profiler.start_trace(str(self.trace_dir))
-                trace_started = True
+        # one watchdog frame per epoch, re-ticked per step: the deadline
+        # covers dataloader waits, step dispatch AND the lagged device_get —
+        # any of them can be the thing that hangs
+        with self._watched(f"train epoch {epoch_i}") as tick:
+            for step_i, (inputs, labels) in enumerate(iterator):
+                _fault("trainer.step")
+                tick(f"train step {self.global_step} (epoch {epoch_i})")
+                if not trace_started and epoch_i == 1 and step_i == trace_from:
+                    jax.profiler.start_trace(str(self.trace_dir))
+                    trace_started = True
 
-            inputs = self._global_batch(self._split_micro(inputs), leading_accum=True)
-            labels = self._global_batch(self._split_micro(labels), leading_accum=True)
+                inputs = self._global_batch(self._split_micro(inputs), leading_accum=True)
+                labels = self._global_batch(self._split_micro(labels), leading_accum=True)
 
-            self.params, self.opt_state, values = self._jit_train_step(
-                self.params, self.opt_state, inputs, labels, self.global_step
-            )
-
-            if trace_started and not trace_stopped and step_i >= trace_from + 2:
-                jax.block_until_ready(values)
-                jax.profiler.stop_trace()
-                trace_stopped = True
-                logger.info(
-                    f"Device trace (steps {trace_from}-{trace_from + 2}) "
-                    f"written to {self.trace_dir}."
+                self.params, self.opt_state, values = self._jit_train_step(
+                    self.params, self.opt_state, inputs, labels, self.global_step
                 )
 
-            lag.feed(values, self.global_step)
-            self.global_step += 1
+                if trace_started and not trace_stopped and step_i >= trace_from + 2:
+                    jax.block_until_ready(values)
+                    jax.profiler.stop_trace()
+                    trace_stopped = True
+                    logger.info(
+                        f"Device trace (steps {trace_from}-{trace_from + 2}) "
+                        f"written to {self.trace_dir}."
+                    )
 
-            if self.debug:
-                logger.info("Training was interrupted because of debug mode.")
-                break
+                lag.feed(values, self.global_step)
+                self.global_step += 1
+                if self.watchdog is not None:
+                    self.watchdog.note_progress(self.global_step)
 
-        lag.flush()
+                if self.debug:
+                    logger.info("Training was interrupted because of debug mode.")
+                    break
+
+            lag.flush()
 
         if trace_started and not trace_stopped:  # epoch ended mid-capture
             jax.block_until_ready(self.params)
@@ -736,19 +763,22 @@ class Trainer:
                 tqdm_data.set_postfix_str(_console_str(avg_meters))
 
         lag = LaggedConsumer(consume, total=len(self.test_dataloader))
-        for i, (inputs, labels) in iterator:
-            dev_inputs = self._global_batch(inputs)
-            dev_labels = self._global_batch(labels)
+        with self._watched(f"test epoch {epoch_i}") as tick:
+            for i, (inputs, labels) in iterator:
+                _fault("trainer.eval_step")
+                tick(f"eval step {i} (epoch {epoch_i})")
+                dev_inputs = self._global_batch(inputs)
+                dev_labels = self._global_batch(labels)
 
-            preds, values = self._jit_eval_step(self.params, dev_inputs, dev_labels)
+                preds, values = self._jit_eval_step(self.params, dev_inputs, dev_labels)
 
-            lag.feed(i, labels, dev_labels, preds, values)
+                lag.feed(i, labels, dev_labels, preds, values)
 
-            if self.debug and i >= 10:
-                logger.info("Test was interrupted because of debug mode.")
-                break
+                if self.debug and i >= 10:
+                    logger.info("Test was interrupted because of debug mode.")
+                    break
 
-        lag.flush()
+            lag.flush()
 
         if callbacks is not None:
             for callback in callbacks:
@@ -790,25 +820,33 @@ class Trainer:
             logger.info(f"Model was not saved to {path_} because of debug mode.")
             return
         opt_state, ls_state = self._split_ls()
-        if self.sharded_checkpoint:
-            from .checkpoint import save_state_dict_sharded
+        # its own watchdog frame: the sharded save crosses process barriers,
+        # and a peer that died mid-save must abort this host (for restart)
+        # rather than park it on the barrier forever. 8x the step timeout:
+        # a save legitimately gathers/writes the FULL state (the non-sharded
+        # path in particular), which dwarfs a step — a slow save must not be
+        # misclassified as a hang and crash-looped. Barriers inside inherit
+        # this budget (watchdog.arm nested-frame default).
+        with self._watched(f"checkpoint save {path_}", scale=8.0):
+            if self.sharded_checkpoint:
+                from .checkpoint import save_state_dict_sharded
 
-            save_state_dict_sharded(
+                save_state_dict_sharded(
+                    path_,
+                    params=self.params,
+                    opt_state=opt_state,
+                    loss_scale=ls_state,
+                    global_step=self.global_step,
+                )
+                return
+            _save_ckpt(
                 path_,
                 params=self.params,
                 opt_state=opt_state,
                 loss_scale=ls_state,
                 global_step=self.global_step,
+                is_primary=self.is_primary,
             )
-            return
-        _save_ckpt(
-            path_,
-            params=self.params,
-            opt_state=opt_state,
-            loss_scale=ls_state,
-            global_step=self.global_step,
-            is_primary=self.is_primary,
-        )
 
     def load_state_dict(self, path_):
         live_opt, live_ls = self._split_ls()

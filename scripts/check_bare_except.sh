@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Lint gate: fail on bare `except:` blocks in ml_recipe_tpu/.
+#
+# A bare except swallows KeyboardInterrupt/SystemExit — it turns the
+# SIGTERM-to-checkpoint path, the watchdog's abort, and injected fault
+# drills into silent no-ops. `except Exception` (or narrower) is always
+# available and is what every handler in this package uses.
+#
+# Usage: scripts/check_bare_except.sh   (exit 0 = clean, 1 = violations)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+hits=$(grep -rnE '^[[:space:]]*except[[:space:]]*:' ml_recipe_tpu/ --include='*.py' || true)
+if [ -n "$hits" ]; then
+    echo "bare 'except:' blocks found (use 'except Exception' or narrower):"
+    echo "$hits"
+    exit 1
+fi
+echo "OK: no bare except blocks in ml_recipe_tpu/."

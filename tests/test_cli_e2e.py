@@ -182,16 +182,18 @@ def test_cli_train_metrics_end_to_end(e2e_trained, monkeypatch):
 
 def test_cli_sigterm_saves_interrupt_checkpoint(e2e, monkeypatch):
     """TPU preemptions deliver SIGTERM: the train CLI must route it into the
-    same interrupt-checkpoint path as Ctrl-C (interrupt.ch)."""
+    same interrupt-checkpoint path as Ctrl-C (interrupt.ch) — and a resume
+    from that emergency checkpoint must land on the saved global_step."""
     import os
     import signal
     import time
 
     tmp, cfg, _ = e2e
     from ml_recipe_tpu.cli import train
-    from ml_recipe_tpu.train import Trainer
+    from ml_recipe_tpu.train import Trainer, peek_global_step
 
     def fake_train(self, *a, **k):
+        self.global_step = 7  # mid-run state the emergency save must carry
         os.kill(os.getpid(), signal.SIGTERM)  # delivered to the main thread
         time.sleep(5)  # interrupted immediately by the handler
         raise AssertionError("SIGTERM handler did not fire")
@@ -203,9 +205,62 @@ def test_cli_sigterm_saves_interrupt_checkpoint(e2e, monkeypatch):
     )
     prev = signal.getsignal(signal.SIGTERM)
     train.cli()
-    assert (tmp / "results" / "sigterm" / "interrupt.ch").exists()
+    interrupt_ch = tmp / "results" / "sigterm" / "interrupt.ch"
+    assert interrupt_ch.exists()
+    assert peek_global_step(interrupt_ch) == 7
     # handler restored after the run
     assert signal.getsignal(signal.SIGTERM) is prev
+
+    # resume from the emergency checkpoint: run_worker's --last load path
+    # must land the trainer on the saved global_step before training
+    resumed = {}
+
+    def fake_train_resume(self, *a, **k):
+        resumed["step"] = self.global_step
+
+    monkeypatch.setattr(Trainer, "train", fake_train_resume)
+    monkeypatch.setattr(
+        sys, "argv",
+        [
+            "train", "-c", str(cfg),
+            "--experiment_name", "sigterm_resume",
+            "--last", str(interrupt_ch),
+        ],
+    )
+    train.cli()
+    assert resumed["step"] == 7
+
+
+def test_cli_sigterm_exits_preempted_under_supervision(e2e, monkeypatch):
+    """Under a supervisor (MLRT_SUPERVISED set), a caught preemption must
+    exit with the tempfail code — the supervisor's cue to RESTART — rather
+    than reading as a clean finish."""
+    import os
+    import signal
+    import time
+
+    from ml_recipe_tpu.cli import train
+    from ml_recipe_tpu.resilience.supervisor import PREEMPT_EXIT_CODE, classify_exit
+    from ml_recipe_tpu.train import Trainer
+
+    tmp, cfg, _ = e2e
+
+    def fake_train(self, *a, **k):
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(5)
+        raise AssertionError("SIGTERM handler did not fire")
+
+    monkeypatch.setattr(Trainer, "train", fake_train)
+    monkeypatch.setenv("MLRT_SUPERVISED", "1")
+    monkeypatch.setattr(
+        sys, "argv",
+        ["train", "-c", str(cfg), "--experiment_name", "sigterm_sup"],
+    )
+    with pytest.raises(SystemExit) as exc_info:
+        train.cli()
+    assert exc_info.value.code == PREEMPT_EXIT_CODE
+    assert classify_exit(PREEMPT_EXIT_CODE) == "preempted"
+    assert (tmp / "results" / "sigterm_sup" / "interrupt.ch").exists()
 
 
 def test_inference_notebook_executes(e2e_trained, monkeypatch):

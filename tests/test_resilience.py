@@ -1,0 +1,920 @@
+"""Chaos suite for the fault-tolerance subsystem (resilience/).
+
+Every scenario is DETERMINISTIC: faults fire on counted arrivals at named
+sites (no timing races, no randomness), so a kill-restart-resume drill
+replays identically run after run — the acceptance bar for trusting any of
+these recovery paths.
+
+Three layers of coverage:
+- unit: FaultPlan grammar/counters, retry helper, watchdog deadlines,
+  supervisor classification/backoff/crash-loop logic, checkpoint crc32 and
+  interrupted-swap recovery windows;
+- loader/predictor satellites: worker traceback preservation, transient
+  read retry, join-timeout visibility;
+- end-to-end: a real child process doing sharded checkpoint saves under an
+  armed fault plan, driven by the real Supervisor — kill between shard and
+  manifest writes, a stalled step tripping the watchdog, and an
+  unrecoverable crash-loop.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ml_recipe_tpu.resilience import faults as faults_mod
+from ml_recipe_tpu.resilience.faults import (
+    KILL_EXIT_CODE,
+    FaultError,
+    FaultPlan,
+    retry_transient,
+)
+from ml_recipe_tpu.resilience.supervisor import (
+    PREEMPT_EXIT_CODE,
+    RetryPolicy,
+    Supervisor,
+    build_child_argv,
+    classify_exit,
+)
+from ml_recipe_tpu.resilience.watchdog import WATCHDOG_EXIT_CODE, Watchdog
+
+pytestmark = pytest.mark.chaos
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_grammar():
+    plan = FaultPlan.parse(
+        "ckpt.pre_manifest:kill@2!once; loader.read:raise@1x3;"
+        "trainer.step:stall~5;dist.barrier:raise@4x*"
+    )
+    kinds = [(s.site, s.kind, s.hit, s.count, s.seconds, s.once) for s in plan.specs]
+    assert kinds == [
+        ("ckpt.pre_manifest", "kill", 2, 1, None, True),
+        ("loader.read", "raise", 1, 3, None, False),
+        ("trainer.step", "stall", 1, 1, 5.0, False),
+        ("dist.barrier", "raise", 4, -1, None, False),
+    ]
+
+
+@pytest.mark.parametrize(
+    "bad", ["typo.site:kill", "loader.read:explode", "loader.read", "a:b@0"]
+)
+def test_fault_plan_rejects_typos(bad):
+    with pytest.raises(ValueError):
+        FaultPlan.parse(bad)
+
+
+def test_fault_plan_counted_arrivals():
+    plan = FaultPlan.parse("loader.read:raise@2x2")
+    plan.fire("loader.read")  # arrival 1: armed at 2 -> no fire
+    for _ in range(2):        # arrivals 2, 3 fire
+        with pytest.raises(FaultError):
+            plan.fire("loader.read")
+    plan.fire("loader.read")  # arrival 4: window passed
+    assert plan.hits("loader.read") == 4
+    plan.fire("trainer.step")  # unarmed site: fast-path no-op (uncounted)
+    assert plan.hits("trainer.step") == 0
+
+
+def test_fault_plan_once_survives_restart(tmp_path):
+    """!once state lives in a marker file: a 'restarted' plan (fresh
+    counters, same state dir) must NOT re-fire — that is what lets a
+    kill-drill converge instead of crash-looping."""
+    state = str(tmp_path / "fault-state")
+    plan1 = FaultPlan.parse("loader.read:raise@1!once", state_dir=state)
+    with pytest.raises(FaultError):
+        plan1.fire("loader.read")
+    plan2 = FaultPlan.parse("loader.read:raise@1!once", state_dir=state)
+    plan2.fire("loader.read")  # marker present: skipped
+    assert plan2.hits("loader.read") == 1
+
+
+def test_fault_once_is_single_shot_under_concurrency(tmp_path):
+    """Concurrent loader threads inside the active window must resolve a
+    !once spec to exactly ONE firing (the check-and-record is under the
+    plan lock) — the determinism contract at the one multi-threaded site."""
+    plan = FaultPlan.parse(
+        "loader.read:raise@1x2!once", state_dir=str(tmp_path / "state")
+    )
+    start = threading.Barrier(2)
+    raises = []
+
+    def arrive():
+        start.wait()
+        try:
+            plan.fire("loader.read")
+        except FaultError:
+            raises.append(1)
+
+    threads = [threading.Thread(target=arrive) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(raises) == 1
+
+
+def test_global_install_and_site_noop():
+    faults_mod.install_plan("trainer.step:raise@1")
+    try:
+        with pytest.raises(FaultError):
+            faults_mod.fire("trainer.step")
+        faults_mod.fire("trainer.eval_step")  # unarmed: no-op
+    finally:
+        faults_mod.install_plan(None)
+    faults_mod.fire("trainer.step")  # disarmed: no-op
+
+
+def test_retry_transient_retries_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry_transient(flaky, retries=3, sleep=lambda _: None) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_transient_exhausts_with_original_error():
+    def always():
+        raise OSError("persistent")
+
+    with pytest.raises(OSError, match="persistent"):
+        retry_transient(always, retries=2, sleep=lambda _: None)
+
+
+# ---------------------------------------------------------------------------
+# Watchdog
+# ---------------------------------------------------------------------------
+
+
+def _test_watchdog(timeout, fired):
+    return Watchdog(
+        timeout,
+        poll_interval=0.01,
+        on_timeout=lambda label: fired.append(label),
+        exit_fn=lambda code: fired.append(code),
+    )
+
+
+def test_watchdog_fires_on_missed_deadline(capsys):
+    fired = []
+    wd = _test_watchdog(0.08, fired)
+    try:
+        with wd.watch("stuck step"):
+            deadline = time.monotonic() + 2.0
+            while not fired and time.monotonic() < deadline:
+                time.sleep(0.01)
+    finally:
+        wd.stop()
+    assert fired == ["stuck step", WATCHDOG_EXIT_CODE]
+    err = capsys.readouterr().err
+    assert "WATCHDOG" in err and "stuck step" in err
+    # the all-thread stack dump names this very test frame
+    assert "test_watchdog_fires_on_missed_deadline" in err
+
+
+def test_watchdog_tick_defers_firing():
+    fired = []
+    wd = _test_watchdog(1.0, fired)
+    try:
+        with wd.watch("epoch") as tick:
+            for i in range(4):
+                tick(f"step {i}")
+                time.sleep(0.1)  # each step well under the deadline
+    finally:
+        wd.stop()
+    assert fired == []
+
+
+def test_watchdog_nested_frames_are_reentrant():
+    """An inner (checkpoint-barrier) frame with a long timeout must shadow
+    the outer step frame, and popping it must restart the outer clock."""
+    fired = []
+    wd = _test_watchdog(0.5, fired)
+    try:
+        with wd.watch("outer"):
+            with wd.watch("inner", timeout=30.0):
+                time.sleep(1.0)  # outer would have expired; inner shadows it
+            time.sleep(0.05)     # outer clock restarted on pop
+        assert fired == []
+    finally:
+        wd.stop()
+
+
+def test_watchdog_notes_last_step(capsys):
+    fired = []
+    wd = _test_watchdog(0.08, fired)
+    try:
+        wd.note_progress(41)
+        with wd.watch("stall"):
+            deadline = time.monotonic() + 2.0
+            while not fired and time.monotonic() < deadline:
+                time.sleep(0.01)
+    finally:
+        wd.stop()
+    assert "last completed step: 41" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# Loader satellites: traceback preservation + transient retry
+# ---------------------------------------------------------------------------
+
+
+class _FlakyDataset:
+    """Items are [i, i]; reads of `fail_index` raise OSError `fails` times."""
+
+    def __init__(self, n=8, fail_index=3, fails=2, exc=OSError):
+        self.n = n
+        self.fail_index = fail_index
+        self.fails_left = fails
+        self.exc = exc
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        if i == self.fail_index and self.fails_left > 0:
+            self.fails_left -= 1
+            raise self.exc(f"injected failure reading item {i}")
+        return np.array([i, i], dtype=np.int32)
+
+
+def test_map_loader_retries_transient_oserror(monkeypatch):
+    from ml_recipe_tpu.data.loader import DataLoader, ShardedBatchSampler
+
+    monkeypatch.setattr(time, "sleep", lambda _: None)  # no backoff waits
+    ds = _FlakyDataset(n=8, fail_index=3, fails=2)
+    sampler = ShardedBatchSampler(8, 4, shuffle=False, drop_last=True)
+    loader = DataLoader(
+        ds, sampler, lambda items: np.stack(items), n_jobs=2, read_retries=3
+    )
+    batches = list(loader)
+    assert len(batches) == 2 and ds.fails_left == 0
+    np.testing.assert_array_equal(
+        np.concatenate(batches)[:, 0], np.arange(8)
+    )
+
+
+def test_list_loader_retries_transient_oserror(monkeypatch):
+    from ml_recipe_tpu.data.loader import ListDataloader
+
+    monkeypatch.setattr(time, "sleep", lambda _: None)
+
+    class ChunkDS(_FlakyDataset):
+        def __getitem__(self, i):
+            return [super().__getitem__(i)]
+
+    loader = ListDataloader(ChunkDS(n=6, fails=2), batch_size=2, n_jobs=2)
+    chunks = [c for batch in loader for c in batch]
+    assert len(chunks) == 6
+
+
+def test_list_loader_preserves_worker_traceback():
+    from ml_recipe_tpu.data.loader import DataLoaderWorkerError, ListDataloader
+
+    class Boom:
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            if i == 2:
+                raise ValueError("boom at item 2")
+            return [np.zeros(1)]
+
+    loader = ListDataloader(Boom(), batch_size=2, n_jobs=2)
+    with pytest.raises(DataLoaderWorkerError) as exc_info:
+        list(loader)
+    msg = str(exc_info.value)
+    # the WORKER's stack (file/function where it died), not just the message
+    assert "boom at item 2" in msg
+    assert "worker traceback" in msg and "__getitem__" in msg
+    assert isinstance(exc_info.value.__cause__, ValueError)
+
+
+def test_predictor_shutdown_surfaces_wedged_worker(caplog):
+    from ml_recipe_tpu.infer.predictor import (
+        WorkerShutdownError,
+        _ensure_worker_stopped,
+    )
+
+    release = threading.Event()
+    wedged = threading.Thread(
+        target=release.wait, name="wedged-worker", daemon=True
+    )
+    wedged.start()
+    try:
+        with caplog.at_level("WARNING"):
+            with pytest.raises(WorkerShutdownError, match="wedged-worker"):
+                _ensure_worker_stopped(wedged, timeout=0.1)
+        assert "still alive" in caplog.text
+        assert "release.wait" in caplog.text or "wait" in caplog.text
+
+        # an exception already in flight must NOT be replaced by the
+        # shutdown complaint — warn only
+        try:
+            raise RuntimeError("original failure")
+        except RuntimeError:
+            _ensure_worker_stopped(wedged, timeout=0.05)  # no raise
+    finally:
+        release.set()
+        wedged.join(timeout=2)
+
+    done = threading.Thread(target=lambda: None)
+    done.start()
+    _ensure_worker_stopped(done, timeout=1.0)  # clean exit: no-op
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint: crc32 verification + interrupted-swap windows + peek
+# ---------------------------------------------------------------------------
+
+
+def _tiny_params():
+    return {
+        "w": np.arange(8, dtype=np.float32),
+        "b": np.float32(3.0),
+    }
+
+
+def _save_sharded(path, params, step):
+    from ml_recipe_tpu.train.checkpoint import save_state_dict_sharded
+
+    save_state_dict_sharded(path, params=params, global_step=step)
+
+
+def test_sharded_crc_roundtrip_and_peek(tmp_path):
+    from ml_recipe_tpu.train.checkpoint import (
+        load_state_dict_sharded,
+        peek_global_step,
+    )
+
+    ckpt = str(tmp_path / "crc.ckpt")
+    _save_sharded(ckpt, _tiny_params(), 5)
+    assert peek_global_step(ckpt) == 5
+
+    p, _, _, step = load_state_dict_sharded(ckpt, params=_tiny_params())
+    assert step == 5
+    np.testing.assert_array_equal(p["w"], np.arange(8, dtype=np.float32))
+
+
+def test_sharded_crc_detects_bit_rot(tmp_path):
+    from ml_recipe_tpu.train.checkpoint import (
+        TornCheckpointError,
+        load_state_dict,
+        load_state_dict_sharded,
+    )
+
+    ckpt = str(tmp_path / "rot.ckpt")
+    _save_sharded(ckpt, _tiny_params(), 5)
+
+    shard = os.path.join(ckpt, "shard-00000.msgpack")
+    blob = bytearray(open(shard, "rb").read())
+    needle = np.arange(8, dtype=np.float32).tobytes()
+    at = blob.find(needle)
+    assert at >= 0, "could not locate leaf bytes in the shard file"
+    blob[at + 5] ^= 0xFF  # single flipped byte inside the array payload
+    open(shard, "wb").write(bytes(blob))
+
+    with pytest.raises(TornCheckpointError, match="crc32"):
+        load_state_dict_sharded(ckpt, params=_tiny_params())
+
+    # the --last resume path keeps its warn-and-continue contract: a
+    # corrupt checkpoint must not crash startup
+    params0 = _tiny_params()
+    p, _, _, step = load_state_dict(ckpt, params=params0)
+    assert step is None and p is params0
+
+
+def test_sharded_crc_detects_hand_assembled_mix(tmp_path):
+    """Two internally-consistent saves at the SAME step, shard file of one
+    placed under the manifest of the other: the step check passes, the
+    manifest leaf checksum must not."""
+    from ml_recipe_tpu.train.checkpoint import (
+        TornCheckpointError,
+        load_state_dict_sharded,
+    )
+
+    a, b = str(tmp_path / "a.ckpt"), str(tmp_path / "b.ckpt")
+    _save_sharded(a, _tiny_params(), 5)
+    other = _tiny_params()
+    other["w"] = other["w"] + 100.0
+    _save_sharded(b, other, 5)
+
+    os.replace(
+        os.path.join(b, "shard-00000.msgpack"),
+        os.path.join(a, "shard-00000.msgpack"),
+    )
+    with pytest.raises(TornCheckpointError, match="manifest"):
+        load_state_dict_sharded(a, params=_tiny_params())
+
+
+def test_peek_global_step_variants(tmp_path):
+    from ml_recipe_tpu.train.checkpoint import peek_global_step, save_state_dict
+
+    assert peek_global_step(str(tmp_path / "missing.ch")) is None
+
+    single = str(tmp_path / "single.ch")
+    save_state_dict(single, params=_tiny_params(), global_step=7)
+    assert peek_global_step(single) == 7
+
+    garbage = str(tmp_path / "garbage.ch")
+    open(garbage, "wb").write(b"not a checkpoint")
+    assert peek_global_step(garbage) is None
+
+    # manifest-less directory (interrupted first sharded save)
+    empty_dir = tmp_path / "empty.ckpt"
+    empty_dir.mkdir()
+    assert peek_global_step(str(empty_dir)) is None
+
+
+# -- _recover_interrupted_swap windows ----------------------------------------
+
+
+def _fake_sharded_dir(path, tag, *, manifest=True):
+    os.makedirs(path)
+    with open(os.path.join(path, "shard-00000.msgpack"), "w") as fh:
+        fh.write(tag)
+    if manifest:
+        with open(os.path.join(path, "manifest.msgpack"), "w") as fh:
+            fh.write(tag)
+
+
+def _tag_of(path):
+    with open(os.path.join(path, "shard-00000.msgpack")) as fh:
+        return fh.read()
+
+
+def test_swap_recovery_rolls_forward_complete_staging(tmp_path):
+    from ml_recipe_tpu.train.checkpoint import _recover_interrupted_swap
+
+    path = str(tmp_path / "c.ckpt")
+    _fake_sharded_dir(path + ".saving", "new", manifest=True)
+    _fake_sharded_dir(path + ".old", "old", manifest=True)
+    _recover_interrupted_swap(path, path + ".saving", path + ".old")
+    assert _tag_of(path) == "new"
+    assert not os.path.exists(path + ".saving")
+
+
+def test_swap_recovery_rolls_back_incomplete_staging(tmp_path):
+    from ml_recipe_tpu.train.checkpoint import _recover_interrupted_swap
+
+    path = str(tmp_path / "c.ckpt")
+    _fake_sharded_dir(path + ".saving", "new", manifest=False)  # died pre-manifest
+    _fake_sharded_dir(path + ".old", "old", manifest=True)
+    _recover_interrupted_swap(path, path + ".saving", path + ".old")
+    assert _tag_of(path) == "old"
+
+
+def test_swap_recovery_noop_when_live_checkpoint_exists(tmp_path):
+    from ml_recipe_tpu.train.checkpoint import _recover_interrupted_swap
+
+    path = str(tmp_path / "c.ckpt")
+    _fake_sharded_dir(path, "live", manifest=True)
+    _fake_sharded_dir(path + ".saving", "new", manifest=True)
+    _recover_interrupted_swap(path, path + ".saving", path + ".old")
+    assert _tag_of(path) == "live"  # untouched
+    assert os.path.isdir(path + ".saving")  # debris is the next save's job
+
+
+def test_swap_recovery_tolerates_losing_the_race(tmp_path, monkeypatch):
+    """A concurrent recoverer's rename wins: ours sees FileNotFoundError,
+    but the live path exists afterwards — that is success, not an error."""
+    from ml_recipe_tpu.train import checkpoint as ckpt_mod
+
+    path = str(tmp_path / "c.ckpt")
+    _fake_sharded_dir(path + ".saving", "new", manifest=True)
+
+    real_rename = os.rename
+
+    def racing_rename(src, dst):
+        # the competing process completes the recovery first...
+        real_rename(src, dst)
+        # ...and ours loses: the source is already gone
+        raise FileNotFoundError(src)
+
+    monkeypatch.setattr(os, "rename", racing_rename)
+    ckpt_mod._recover_interrupted_swap(path, path + ".saving", path + ".old")
+    monkeypatch.undo()
+    assert _tag_of(path) == "new"
+
+
+def test_swap_recovery_reraises_genuine_failure(tmp_path, monkeypatch):
+    from ml_recipe_tpu.train import checkpoint as ckpt_mod
+
+    path = str(tmp_path / "c.ckpt")
+    _fake_sharded_dir(path + ".saving", "new", manifest=True)
+
+    def failing_rename(src, dst):
+        raise PermissionError(src)  # path still missing afterwards
+
+    monkeypatch.setattr(os, "rename", failing_rename)
+    with pytest.raises(PermissionError):
+        ckpt_mod._recover_interrupted_swap(path, path + ".saving", path + ".old")
+
+
+# ---------------------------------------------------------------------------
+# Supervisor unit
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "rc,outcome",
+    [
+        (0, "clean"),
+        (WATCHDOG_EXIT_CODE, "hang"),
+        (PREEMPT_EXIT_CODE, "preempted"),
+        (-15, "preempted"),
+        (143, "preempted"),
+        (-9, "preempted"),
+        (1, "crash"),
+        (KILL_EXIT_CODE, "crash"),
+    ],
+)
+def test_classify_exit(rc, outcome):
+    assert classify_exit(rc) == outcome
+
+
+def _scripted_supervisor(children, steps, policy):
+    child_iter = iter(children)
+    step_iter = iter(steps)
+    return Supervisor(
+        lambda i: next(child_iter),
+        progress=lambda: next(step_iter),
+        policy=policy,
+        sleep=lambda s: None,
+    )
+
+
+def test_supervisor_resumes_after_crash_with_progress():
+    # progress() runs before and after every attempt
+    res = _scripted_supervisor(
+        [1, 0], [None, 1, 1, 2], RetryPolicy(max_restarts=3)
+    ).run()
+    assert res.status == "clean"
+    assert res.outcomes() == ["crash", "clean"]
+    assert res.exit_code == 0
+
+
+def test_supervisor_aborts_crash_loop_with_diagnosis(capsys):
+    res = _scripted_supervisor(
+        [1, 1, 1, 1], [None] * 8,
+        RetryPolicy(max_restarts=5, crash_loop_window=2),
+    ).run()
+    assert res.status == "crash-loop"
+    assert res.outcomes() == ["crash", "crash"]  # aborted at the window
+    assert res.exit_code == 1
+    assert "crash-loop" in res.diagnosis and "no global_step progress" in res.diagnosis
+    assert "crash-loop" in capsys.readouterr().err
+
+
+def test_supervisor_progress_resets_crash_loop_streak():
+    # each failure makes checkpoint progress: never a crash-loop
+    res = _scripted_supervisor(
+        [1, 1, 0], [None, 1, 1, 2, 2, 3],
+        RetryPolicy(max_restarts=5, crash_loop_window=2),
+    ).run()
+    assert res.status == "clean"
+
+
+def test_supervisor_exhausts_retry_budget():
+    # only NO-progress failures consume the budget; window > budget so the
+    # crash-loop detector stays out of the way
+    res = _scripted_supervisor(
+        [PREEMPT_EXIT_CODE] * 2, [None] * 4,
+        RetryPolicy(max_restarts=1, crash_loop_window=5),
+    ).run()
+    assert res.status == "retries-exhausted"
+    assert res.outcomes() == ["preempted", "preempted"]
+    assert res.exit_code == 2
+    assert "retry budget exhausted" in res.diagnosis
+
+
+def test_supervisor_progressing_preemptions_do_not_burn_budget():
+    """Preemption is the steady state: attempts that failed but ADVANCED
+    the checkpoint must not consume the restart budget — a healthy
+    preemption-heavy run outlives any fixed max_restarts."""
+    children = [PREEMPT_EXIT_CODE] * 5 + [0]
+    steps = [None, 1, 1, 2, 2, 3, 3, 4, 4, 5, 5, 6]
+    res = _scripted_supervisor(
+        children, steps, RetryPolicy(max_restarts=2, crash_loop_window=3)
+    ).run()
+    assert res.status == "clean"
+    assert len(res.attempts) == 6  # far beyond max_restarts + 1
+
+
+def test_supervisor_backoff_is_seeded_and_bounded():
+    policy = RetryPolicy(
+        max_restarts=3, backoff_base=1.0, backoff_factor=2.0,
+        backoff_max=3.0, jitter=0.1, crash_loop_window=10, seed=7,
+    )
+
+    def backoffs():
+        # no-progress failures: backoff doubles with the streak (1, 2,
+        # then capped at 3), with seeded +-10% jitter
+        res = _scripted_supervisor([1, 1, 1, 0], [None] * 8, policy).run()
+        return [a.backoff for a in res.attempts]
+
+    b1, b2 = backoffs(), backoffs()
+    assert b1 == b2  # deterministic across runs
+    for expected, got in zip([1.0, 2.0, 3.0], b1):
+        assert expected * 0.9 <= got <= expected * 1.1
+    assert b1[-1] == 0.0  # no sleep after the final (clean) attempt
+
+
+def test_supervisor_forwards_termination_and_stands_down():
+    """SIGTERM on the SUPERVISOR forwards to the live child and ends the
+    loop after the child exits — never an orphaned trainer racing the next
+    submission on the checkpoint directory, never a restart."""
+    import signal as signal_mod
+
+    sent = []
+    holder = {}
+
+    class FakeChild:
+        def send_signal(self, signum):
+            sent.append(int(signum))
+
+        def wait(self, timeout=None):
+            # the signal lands while the supervisor blocks in wait()
+            holder["sup"]._forward_signal(signal_mod.SIGTERM, None)
+            return PREEMPT_EXIT_CODE  # child saved interrupt.ch and exited
+
+    sup = Supervisor(
+        lambda i: FakeChild(),
+        progress=lambda: 3,
+        policy=RetryPolicy(max_restarts=5),
+        sleep=lambda s: None,
+    )
+    holder["sup"] = sup
+    res = sup.run()
+    assert sent == [int(signal_mod.SIGTERM)]
+    assert res.status == "terminated"
+    assert len(res.attempts) == 1  # no restart after the forwarded signal
+    assert res.exit_code == 128 + int(signal_mod.SIGTERM)
+    assert "terminated by signal" in res.diagnosis
+
+
+def test_build_child_argv_strips_and_repoints():
+    argv = ["-c", "cfg", "--supervise", "--last", "stale.ch", "--n_epochs", "2"]
+    assert build_child_argv(argv, resume="new.ch") == [
+        "-c", "cfg", "--n_epochs", "2", "--last", "new.ch",
+    ]
+    # without a resume target, an explicit --last is the user's to keep
+    assert build_child_argv(argv) == [
+        "-c", "cfg", "--last", "stale.ch", "--n_epochs", "2",
+    ]
+    assert build_child_argv(["--supervise=true", "--last=x"], resume="y.ch") == [
+        "--last", "y.ch",
+    ]
+
+
+# ---------------------------------------------------------------------------
+# End-to-end chaos: real child processes through the real Supervisor
+# ---------------------------------------------------------------------------
+
+_CHILD_SCRIPT = textwrap.dedent(
+    """
+    import os, sys
+    import numpy as np
+
+    from ml_recipe_tpu.resilience import faults
+    from ml_recipe_tpu.resilience.watchdog import Watchdog, install
+    from ml_recipe_tpu.train.checkpoint import (
+        load_state_dict, peek_global_step, save_state_dict_sharded,
+    )
+
+    ckpt = sys.argv[1]
+    n_steps = int(sys.argv[2])
+
+    wd_timeout = float(os.environ.get("WD_TIMEOUT", "0") or 0)
+    wd = install(Watchdog(wd_timeout)) if wd_timeout else None
+
+    params = {"w": np.zeros(4, dtype=np.float32)}
+    start = 0
+    if peek_global_step(ckpt) is not None:
+        params, _, _, got = load_state_dict(ckpt, params=params)
+        start = got or 0
+
+    ctx = wd.watch("training run") if wd else None
+    tick = ctx.__enter__() if ctx else (lambda *a: None)
+    for step in range(start + 1, n_steps + 1):
+        faults.fire("trainer.step")
+        tick(f"step {step}")
+        params = {"w": params["w"] + 1.0}
+        save_state_dict_sharded(ckpt, params=params, global_step=step)
+        if wd is not None:
+            wd.note_progress(step)
+    if ctx is not None:
+        ctx.__exit__(None, None, None)
+    print(f"DONE step={n_steps} w0={float(params['w'][0])}")
+    """
+)
+
+_FAST_POLICY = RetryPolicy(
+    max_restarts=3, backoff_base=0.01, backoff_max=0.02,
+    crash_loop_window=2, seed=0,
+)
+
+
+def _run_supervised(tmp_path, run_tag, *, fault_plan, wd_timeout=None, n_steps=3):
+    """One supervised run of the child script in a fresh directory; returns
+    (result, final peeked step, collected child stderr)."""
+    run_dir = tmp_path / run_tag
+    run_dir.mkdir()
+    script = run_dir / "child.py"
+    script.write_text(_CHILD_SCRIPT)
+    ckpt = str(run_dir / "state.ckpt")
+    log = run_dir / "child.log"
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MLRT_FAULTS"] = fault_plan
+    env["MLRT_FAULT_STATE"] = str(run_dir / "fault-state")
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    if wd_timeout is not None:
+        env["WD_TIMEOUT"] = str(wd_timeout)
+
+    def launch(attempt_i):
+        fh = open(log, "ab")
+        return subprocess.Popen(
+            [sys.executable, str(script), ckpt, str(n_steps)],
+            env=env, cwd=REPO_ROOT, stdout=fh, stderr=fh,
+        )
+
+    from ml_recipe_tpu.train.checkpoint import peek_global_step
+
+    sup = Supervisor(
+        launch,
+        progress=lambda: peek_global_step(ckpt),
+        policy=_FAST_POLICY,
+        attempt_timeout=120,
+        sleep=lambda s: None,
+    )
+    result = sup.run()
+    return result, peek_global_step(ckpt), log.read_text(errors="replace")
+
+
+def test_chaos_kill_between_shard_and_manifest(tmp_path):
+    """Acceptance (a): a kill between shard-write and manifest-write leaves
+    the previous checkpoint loadable; the supervisor resumes at its
+    global_step and the run completes — identically on a second run."""
+    from ml_recipe_tpu.train.checkpoint import load_state_dict_sharded
+
+    summaries = []
+    for tag in ("run1", "run2"):
+        result, final_step, log = _run_supervised(
+            tmp_path, tag, fault_plan="ckpt.pre_manifest:kill@2!once"
+        )
+        assert result.status == "clean"
+        assert result.outcomes() == ["crash", "clean"]
+        killed = result.attempts[0]
+        assert killed.returncode == KILL_EXIT_CODE
+        # the kill hit step 2's save: step 1's checkpoint survived and is
+        # what the second attempt resumed from
+        assert killed.step_after == 1
+        assert result.attempts[1].step_before == 1
+        assert final_step == 3
+        # resumed values are continuous: w == n_steps proves the restart
+        # loaded step 1's params rather than starting over
+        p, _, _, _ = load_state_dict_sharded(
+            str(tmp_path / tag / "state.ckpt"),
+            params={"w": np.zeros(4, dtype=np.float32)},
+        )
+        assert float(p["w"][0]) == 3.0
+        assert "FAULT: kill at ckpt.pre_manifest" in log
+        summaries.append(
+            (result.outcomes(), [a.returncode for a in result.attempts],
+             [round(a.backoff, 9) for a in result.attempts])
+        )
+    assert summaries[0] == summaries[1], "chaos scenario must be deterministic"
+
+
+def test_chaos_stall_trips_watchdog_and_recovers(tmp_path):
+    """Acceptance (b): an injected step stall trips the watchdog (stack
+    dump + abort with the hang exit code); the supervisor restarts and the
+    run completes within the retry budget — deterministically."""
+    summaries = []
+    for tag in ("run1", "run2"):
+        result, final_step, log = _run_supervised(
+            tmp_path, tag,
+            # stall >> timeout >> any legitimate step even on a loaded CI
+            # machine: the drill must only ever trip on the injected stall
+            fault_plan="trainer.step:stall@2~60!once",
+            wd_timeout=3.0,
+        )
+        assert result.status == "clean"
+        assert result.outcomes() == ["hang", "clean"]
+        assert result.attempts[0].returncode == WATCHDOG_EXIT_CODE
+        assert result.attempts[0].step_after == 1  # stalled at step 2
+        assert final_step == 3
+        assert "WATCHDOG" in log and "exceeded 3s" in log
+        assert "last completed step: 1" in log
+        # the dump names the stalled frame (time.sleep inside the fault)
+        assert "Thread" in log or "thread" in log
+        summaries.append((result.outcomes(), [a.returncode for a in result.attempts]))
+    assert summaries[0] == summaries[1]
+
+
+def test_chaos_crash_loop_aborts_with_diagnosis(tmp_path, capsys):
+    """Acceptance (c): an unrecoverable crash-loop aborts after K attempts
+    with a non-zero exit and a diagnosis line — not a burned retry budget."""
+    summaries = []
+    for tag in ("run1", "run2"):
+        result, final_step, log = _run_supervised(
+            tmp_path, tag, fault_plan="trainer.step:raise@1x*"
+        )
+        assert result.status == "crash-loop"
+        assert result.exit_code != 0
+        assert result.outcomes() == ["crash", "crash"]  # window == 2
+        assert final_step is None  # never saved anything
+        assert "crash-loop" in result.diagnosis
+        assert "no global_step progress" in result.diagnosis
+        assert "injected fault at trainer.step" in log
+        summaries.append((result.outcomes(), [a.returncode for a in result.attempts]))
+    assert summaries[0] == summaries[1]
+
+
+# ---------------------------------------------------------------------------
+# Full CLI drill (slow tier): --supervise end-to-end through cli.train
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_cli_supervise_recovers_from_checkpoint_kill(tmp_path):
+    """`train --supervise` with a one-shot kill during the epoch-end
+    checkpoint save: attempt 1 dies mid-save, attempt 2 reruns to a clean
+    finish — the whole loop through the real CLI entry point."""
+    from helpers import make_tokenizer, nq_line, write_corpus
+
+    make_tokenizer(tmp_path)
+    corpus = write_corpus(tmp_path, [nq_line(example_id=str(i)) for i in range(8)])
+    cfg = tmp_path / "sup.cfg"
+    cfg.write_text(
+        "\n".join(
+            [
+                "model=bert-tiny",
+                f"vocab_file={tmp_path / 'vocab.txt'}",
+                f"data_path={corpus}",
+                f"processed_data_path={tmp_path / 'processed'}",
+                f"dump_dir={tmp_path / 'results'}",
+                "experiment_name=sup",
+                "max_seq_len=64",
+                "max_question_len=16",
+                "doc_stride=16",
+                "n_epochs=1",
+                "train_batch_size=8",
+                "test_batch_size=8",
+                "n_jobs=2",
+                "seed=0",
+            ]
+        )
+        + "\n"
+    )
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MLRT_FAULT_STATE"] = str(tmp_path / "fault-state")
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "ml_recipe_tpu.cli.train",
+            "-c", str(cfg),
+            "--supervise",
+            "--max_restarts", "2",
+            "--backoff_base", "0.01",
+            "--backoff_max", "0.02",
+            "--fault_plan", "ckpt.pre_write:kill@1!once",
+        ],
+        env=env, cwd=REPO_ROOT, capture_output=True, text=True, timeout=1200,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert (tmp_path / "results" / "sup" / "last.ch").exists()
+    assert "FAULT: kill at ckpt.pre_write" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# Tooling: the bare-except lint gate
+# ---------------------------------------------------------------------------
+
+
+def test_no_bare_except_in_package():
+    script = os.path.join(REPO_ROOT, "scripts", "check_bare_except.sh")
+    proc = subprocess.run(
+        ["bash", script], capture_output=True, text=True, cwd=REPO_ROOT
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
