@@ -47,6 +47,13 @@ TPU_BF16_PEAK_TFLOPS = (
 )
 
 
+def _str2bool(value: str) -> bool:
+    """Boolean-flag domain of ml_recipe_tpu.config.parser._str2bool, kept
+    inline because importing the parser pulls jax in at argparse time and
+    bench defers every heavy import until after _acquire_backend."""
+    return str(value).strip().lower() in ("1", "true", "yes", "on")
+
+
 def _chip_peak_tflops(backend: str):
     if backend != "tpu":
         return None
@@ -506,12 +513,26 @@ def main() -> None:
     parser.add_argument("--converge_lr", type=float, default=1e-4)
     parser.add_argument("--converge_warmup", type=float, default=0.2)
     parser.add_argument("--converge_examples", type=int, default=2048)
+    # geometry autotuner + HBM pre-flight (mirrors config/parser.py)
+    parser.add_argument("--autotune", type=_str2bool, default=True,
+                        help="Compile-probe kernel geometry autotuner; off "
+                             "reverts to analytic VMEM arithmetic.")
+    parser.add_argument("--autotune_cache", type=str, default=None,
+                        help="Tuning-cache directory (default "
+                             "artifacts/tuning/ or $MLRT_AUTOTUNE_CACHE).")
+    parser.add_argument("--hbm_preflight", type=_str2bool, default=True,
+                        help="Raise batch_split from compiled "
+                             "memory_analysis instead of OOMing in XLA.")
     args = parser.parse_args()
 
     try:
         _acquire_backend()
     except RuntimeError as e:
         return _emit_backend_failure(e)
+
+    from ml_recipe_tpu.ops import autotune
+
+    autotune.configure(enabled=args.autotune, cache_dir=args.autotune_cache)
 
     if args.mode == "infer":
         return bench_infer(args)
@@ -550,6 +571,7 @@ def main() -> None:
         model=model, params=params, loss=build_loss(TP()),
         collate_fun=None, trainer_params=None,  # step built manually below
         mesh=mesh, batch_split=args.batch_split, seed=0,
+        train_batch_size=args.global_batch, hbm_preflight=args.hbm_preflight,
     )
     # test-only Trainer skips optimizer construction; build it for the bench
     from ml_recipe_tpu.train.optim import build_optimizer
@@ -559,25 +581,37 @@ def main() -> None:
         warmup_coef=0.0,
     )
     trainer.init_opt_state()
-    step_fn = trainer._build_train_step()
 
-    G = args.batch_split
+    # UNSPLIT host batch: the HBM pre-flight may raise batch_split, and the
+    # micro split must follow whatever it decides
     host_inputs = {
-        "input_ids": rng.integers(1, cfg.vocab_size, (G, B // G, L)).astype(np.int32),
-        "attention_mask": np.ones((G, B // G, L), dtype=np.int32),
-        "token_type_ids": np.zeros((G, B // G, L), dtype=np.int32),
+        "input_ids": rng.integers(1, cfg.vocab_size, (B, L)).astype(np.int32),
+        "attention_mask": np.ones((B, L), dtype=np.int32),
+        "token_type_ids": np.zeros((B, L), dtype=np.int32),
     }
     host_labels = {
-        "start_class": rng.integers(0, L, (G, B // G)).astype(np.int32),
-        "end_class": rng.integers(0, L, (G, B // G)).astype(np.int32),
-        "start_reg": rng.random((G, B // G)).astype(np.float32),
-        "end_reg": rng.random((G, B // G)).astype(np.float32),
-        "cls": rng.integers(0, 5, (G, B // G)).astype(np.int32),
+        "start_class": rng.integers(0, L, (B,)).astype(np.int32),
+        "end_class": rng.integers(0, L, (B,)).astype(np.int32),
+        "start_reg": rng.random((B,)).astype(np.float32),
+        "end_reg": rng.random((B,)).astype(np.float32),
+        "cls": rng.integers(0, 5, (B,)).astype(np.int32),
     }
 
     with mesh:
-        inputs = trainer._global_batch(host_inputs, leading_accum=True)
-        labels = trainer._global_batch(host_labels, leading_accum=True)
+        # pre-flight: compile once, read memory_analysis, raise batch_split
+        # if the requested configuration exceeds device HBM (the compile is
+        # jit-cached, so this is also the first step's compile)
+        trainer.preflight_train_step(host_inputs, host_labels)
+        if trainer._jit_train_step is None:
+            trainer._jit_train_step = trainer._build_train_step()
+        step_fn = trainer._jit_train_step
+
+        inputs = trainer._global_batch(
+            trainer._split_micro(host_inputs), leading_accum=True
+        )
+        labels = trainer._global_batch(
+            trainer._split_micro(host_labels), leading_accum=True
+        )
 
         params_d, opt_d = trainer.params, trainer.opt_state
         for i in range(args.warmup):
@@ -609,6 +643,7 @@ def main() -> None:
     train_gflops = _matmul_gflops_per_example(cfg, L, train=True)
     peak = _chip_peak_tflops(jax.default_backend())
 
+    tuning = autotune.get().session_summary()
     print(
         json.dumps(
             {
@@ -624,6 +659,14 @@ def main() -> None:
                     round(s * 1000.0, 1) for s in window_step_s
                 ],
                 "global_batch": args.global_batch,
+                # pre-flight may have raised this above --batch_split
+                "batch_split": trainer.batch_split,
+                "hbm_preflight": trainer.preflight_report,
+                # tuning provenance: 'hit' = every geometry served from the
+                # on-disk cache (zero compile probes this run)
+                "autotune_cache": tuning["cache"],
+                "autotune_probes": tuning["probes"],
+                "autotune_geometry": tuning["decisions"],
                 "ln_impl": args.ln_impl,
                 "n_chips": n_chips,
                 "backend": jax.default_backend(),

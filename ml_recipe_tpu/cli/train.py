@@ -91,6 +91,15 @@ def _run_worker(params, model_params, watchdog) -> None:
         filemode="a", logger_name="train", debug=params.debug,
     )
 
+    # Geometry autotuner wiring: --autotune / --autotune_cache drive the
+    # process-wide selector the attention kernels consult (ops/autotune.py).
+    from ..ops import autotune
+
+    autotune.configure(
+        enabled=getattr(params, "autotune", True),
+        cache_dir=getattr(params, "autotune_cache", None),
+    )
+
     mesh = build_mesh(params.mesh)
     local_logger.warning(
         f"Process {jax.process_index()}/{jax.process_count()}. "
@@ -150,6 +159,7 @@ def _run_worker(params, model_params, watchdog) -> None:
             if getattr(params, "trace", False) else None
         ),
         watchdog=watchdog,
+        hbm_preflight=getattr(params, "hbm_preflight", True),
     )
 
     if params.last is not None:

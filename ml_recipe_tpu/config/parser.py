@@ -398,6 +398,26 @@ def get_trainer_parser() -> ConfigArgumentParser:
 
     parser.add_argument("--warmup_coef", type=float, default=0.05, help="Warmup coefficient.")
 
+    # Kernel geometry autotuner + HBM pre-flight planner (measured
+    # configuration over analytic byte-counting).
+    parser.add_argument("--autotune", type=_str2bool, default=True,
+                        help="Compile-probe kernel geometry autotuner "
+                             "(ops/autotune.py): on TPU, attention block "
+                             "geometries are validated with real lowering "
+                             "probes, ranked by modeled step cost, and "
+                             "persisted in the on-disk tuning cache; off "
+                             "reverts to pure analytic VMEM arithmetic. "
+                             "CPU/interpret always uses the arithmetic.")
+    parser.add_argument("--autotune_cache", type=cast2(str), default=None,
+                        help="Directory of the tuning cache (default "
+                             "artifacts/tuning/, or $MLRT_AUTOTUNE_CACHE).")
+    parser.add_argument("--hbm_preflight", type=_str2bool, default=True,
+                        help="Before the first train step, compile once and "
+                             "read XLA's memory_analysis; if the step "
+                             "exceeds device HBM, raise batch_split "
+                             "(logged with before/after byte counts) "
+                             "instead of dying in XLA allocation.")
+
     # Mixed precision: native policy + accepted Apex aliases.
     parser.add_argument("--precision", type=cast2(str), default=None,
                         choices=[None, "f32", "bf16"],

@@ -115,14 +115,23 @@ def dot_product_attention(
         L, H, D = q.shape[1], q.shape[2], q.shape[3]
         in_isz = jnp.dtype(q.dtype).itemsize
         out_isz = jnp.dtype(dtype).itemsize
+        # The real input/output/mask dtypes ride along so the feasibility
+        # answer comes from the SAME autotune key the execution path will
+        # select through (compile-probe-validated on TPU, analytic
+        # arithmetic elsewhere) — a differently-keyed answer could disagree
+        # with the execution selection and double-probe.
         # Dropout needs BOTH kernel directions feasible: the forward's
         # in-kernel mask cannot be reproduced by an XLA fallback backward.
+        mask_dtype = mask.dtype if mask is not None else jnp.int32
         blocked_ok = supports_blocked_fwd(
-            L, H, D, in_isz, out_isz, dropout_rate
+            L, H, D, in_isz, out_isz, dropout_rate,
+            in_dtype=q.dtype, out_dtype=dtype, mask_dtype=mask_dtype,
         ) and (
             dropout_rate == 0.0
             or supports_blocked_bwd(L, H, D, in_isz, dropout_rate,
-                                    out_itemsize=out_isz)
+                                    out_itemsize=out_isz,
+                                    in_dtype=q.dtype, out_dtype=dtype,
+                                    mask_dtype=mask_dtype)
         )
         resident_ok = supports_fused_bwd(L) or blocked_ok
         # The streaming-KV regime serves lengths the resident-KV kernels
@@ -130,7 +139,8 @@ def dot_product_attention(
         # apply — their on-chip numbers are recorded; streaming replaces
         # only the XLA fallback.
         streaming_ok = not resident_ok and supports_streaming(
-            L, H, D, in_isz, out_isz, dropout_rate
+            L, H, D, in_isz, out_isz, dropout_rate,
+            in_dtype=q.dtype, out_dtype=dtype, mask_dtype=mask_dtype,
         )
         shapes_ok = resident_ok or streaming_ok
 

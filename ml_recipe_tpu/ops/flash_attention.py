@@ -48,6 +48,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import autotune
+
 _NEG_INF = -1e30
 
 # Fully-fused fwd+bwd limit: the per-head [L, L] f32 temporaries (scores,
@@ -323,8 +325,9 @@ def _blocked_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, o_ref,
     """One (batch, head-group, q-block) program for longer sequences, with
     optional in-kernel attention-probs dropout (keep-bits keyed by the
     absolute row index so the backward regenerates the same mask). A
-    trailing ``lse_ref`` output ([1, hc, q_blk] f32, rows on the lane axis)
-    saves each row's logsumexp for the backward, like the fused kernel's."""
+    trailing ``lse_ref`` output — the ``(1, 1, 1, hc*q_blk)`` head-major
+    lane wire block of ``_lse_pack`` (lane = h*q_blk + row) — saves each
+    row's logsumexp for the backward, like the fused kernel's."""
     b, hj, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     mask = mask_ref[0, 0, :]
     L = k_ref.shape[1]
@@ -370,15 +373,38 @@ def _pick_q_block(L: int) -> Optional[int]:
     return None
 
 
-def supports_fused_bwd(L: int) -> bool:
-    """True when the fully-fused fwd+bwd (and therefore dropout) applies."""
-    return L <= _FUSED_BWD_MAX_LEN and _pick_q_block(L) is not None
+def supports_fused_bwd(L: int, interpret: bool = False) -> bool:
+    """True when the fully-fused fwd+bwd (and therefore dropout) applies.
+
+    On a compiled TPU backend the length is additionally gated on
+    ``L % 128 == 0`` (ADVICE r5 #1): the head-major lse wire block slices
+    lanes at offsets ``h*L`` with width ``hc*L``, and Mosaic requires
+    128-aligned lane slices on hardware — a constraint interpret mode never
+    checks, so e.g. L=264 passes every interpret-mode test and then fails to
+    lower on a real chip. Interpret/CPU keeps the old envelope so tier-1
+    behavior is unchanged; such lengths route to the XLA path on hardware.
+    """
+    if not (L <= _FUSED_BWD_MAX_LEN and _pick_q_block(L) is not None):
+        return False
+    if interpret or jax.default_backend() != "tpu":
+        return True
+    return L % 128 == 0
 
 
 def _sublane8(n: int) -> int:
     """Round a sublane count up to the (8, 128)-tile granularity — the
     VMEM footprint of an [n, lanes] f32 block."""
     return ((n + 7) // 8) * 8
+
+
+def _dtype_for_itemsize(itemsize: int, dtype=None):
+    """Dtype for an autotune probe key when the caller only knows the
+    itemsize (the ``supports_*`` dispatcher signatures): an explicit dtype
+    wins; otherwise 2 -> bf16, anything else -> f32 — the two itemsizes the
+    kernels actually carry."""
+    if dtype is not None:
+        return jnp.dtype(dtype)
+    return jnp.dtype(jnp.bfloat16) if itemsize == 2 else jnp.dtype(jnp.float32)
 
 
 def _lse_pack(lse, qb: int):
@@ -519,23 +545,14 @@ def _pick_head_chunk(H: int, D: int, bytes_per_head: int,
     return min(legal)
 
 
-def _flash_forward(q, k, v, mask, seed, dtype, rate, interpret: bool,
-                   want_lse: bool = False):
-    B, L, H, D = q.shape
-    hc = _pick_head_chunk(
-        H, D,
-        # the (1, 1, 1, hc*L) lse wire block occupies 8 sublanes x hc*L
-        # lanes of f32 in VMEM (dim-of-1 pads to the 8-row tile floor),
-        # double-buffered: exactly 2*8*L*4 bytes per head
-        bytes_per_head=2 * L * D * (3 * q.dtype.itemsize
-                                    + jnp.dtype(dtype).itemsize)
-        + (2 * _sublane8(1) * L * 4 if want_lse else 0),
-        temp_bytes=3 * L * L * 4,  # scores/probs/dropout-uniform f32
-    )
+def _build_fused_fwd_call(B, L, H, D, in_dtype, out_dtype, rate, hc,
+                          interpret, want_lse):
+    """The forward ``pallas_call`` for one head-chunk choice, shared by the
+    execution path and the autotuner's compile probe so they cannot drift."""
     spec_lf = pl.BlockSpec((1, L, hc * D), lambda b, hj, *_: (b, 0, hj))
 
     out_specs = [spec_lf]
-    out_shape = [jax.ShapeDtypeStruct((B, L, H * D), dtype)]
+    out_shape = [jax.ShapeDtypeStruct((B, L, H * D), out_dtype)]
     if want_lse:
         # head-major wire layout (see _lse_pack): qb = L here (one q block)
         out_specs.append(
@@ -545,7 +562,7 @@ def _flash_forward(q, k, v, mask, seed, dtype, rate, interpret: bool,
             jax.ShapeDtypeStruct((B, 1, 1, H * L), jnp.float32)
         )
 
-    res = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(_fused_fwd_kernel, scale=1.0 / (D ** 0.5),
                           rate=rate, hc=hc, D=D),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -559,7 +576,79 @@ def _flash_forward(q, k, v, mask, seed, dtype, rate, interpret: bool,
         ),
         out_shape=out_shape,
         interpret=interpret,
-    )(_row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v))
+    )
+
+
+def _fused_fwd_analytic_hc(L, H, D, in_itemsize, out_itemsize,
+                           want_lse) -> int:
+    """The pre-autotuner arithmetic pick for the fused forward (kept as the
+    autotuner's ranking prior and its no-probe fallback)."""
+    return _pick_head_chunk(
+        H, D,
+        # the (1, 1, 1, hc*L) lse wire block occupies 8 sublanes x hc*L
+        # lanes of f32 in VMEM (dim-of-1 pads to the 8-row tile floor),
+        # double-buffered: exactly 2*8*L*4 bytes per head
+        bytes_per_head=2 * L * D * (3 * in_itemsize + out_itemsize)
+        + (2 * _sublane8(1) * L * 4 if want_lse else 0),
+        temp_bytes=3 * L * L * 4,  # scores/probs/dropout-uniform f32
+    )
+
+
+def _fused_fwd_hc(B, L, H, D, in_dtype, mask_dtype, out_dtype, rate,
+                  want_lse, interpret) -> int:
+    """Head-chunk selection for the fused forward, through the autotuner:
+    probe-validated on TPU, the old arithmetic elsewhere."""
+    in_isz = jnp.dtype(in_dtype).itemsize
+    out_isz = jnp.dtype(out_dtype).itemsize
+
+    def analytic():
+        return _fused_fwd_analytic_hc(L, H, D, in_isz, out_isz, want_lse)
+
+    def cost(hc):
+        # fewer head-groups = fewer grid programs and fewer k/v streams;
+        # per-group block bytes scale with hc either way
+        return H // hc
+
+    def probe(hc):
+        args = [
+            jax.ShapeDtypeStruct((1,), jnp.int32),          # row seeds
+            jax.ShapeDtypeStruct((1, 1, L), mask_dtype),    # mask
+            *[jax.ShapeDtypeStruct((1, L, H * D), in_dtype)] * 3,  # q k v
+        ]
+        call = _build_fused_fwd_call(1, L, H, D, in_dtype, out_dtype, rate,
+                                     hc, interpret=False, want_lse=want_lse)
+        return _probe_compiles(call, args,
+                               aggressive=cost(hc) < cost(analytic()))
+
+    hc = autotune.get().select(
+        "fused_fwd_lse" if want_lse else "fused_fwd",
+        L=L, H=H, D=D, in_dtype=jnp.dtype(in_dtype), out_dtype=out_dtype,
+        dropout=rate > 0.0, extra=f"mask{jnp.dtype(mask_dtype)}",
+        candidates=sorted(_legal_head_chunks(H, D), reverse=True),
+        cost=cost, probe=probe, analytic=analytic, interpret=interpret,
+    )
+    # no candidate compiled: fall back to the smallest legal chunk and let
+    # Mosaic fail loudly downstream (the old gate's terminal behavior)
+    return hc if hc is not None else min(_legal_head_chunks(H, D))
+
+
+def _flash_forward(q, k, v, mask, seed, dtype, rate, interpret: bool,
+                   want_lse: bool = False):
+    B, L, H, D = q.shape
+    if want_lse and not interpret:
+        # compiled-path invariant behind supports_fused_bwd's L % 128 gate
+        # (ADVICE r5 #1): the head-major lse wire block needs 128-aligned
+        # lane slices on hardware
+        assert L % 128 == 0 or jax.default_backend() != "tpu", (
+            f"fused want_lse path needs L % 128 == 0 on TPU, got L={L}; "
+            f"gate on supports_fused_bwd"
+        )
+    hc = _fused_fwd_hc(B, L, H, D, q.dtype, mask.dtype, jnp.dtype(dtype),
+                       rate, want_lse, interpret)
+    res = _build_fused_fwd_call(B, L, H, D, q.dtype, dtype, rate, hc,
+                                interpret, want_lse)(
+        _row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v)
+    )
     if want_lse:
         return res[0].reshape(B, L, H, D), _lse_unpack(res[1], L, H)
     return res[0].reshape(B, L, H, D)
@@ -618,94 +707,103 @@ def _looks_like_vmem_overflow(err: Exception) -> bool:
             or "scoped" in msg or "out of memory" in msg)
 
 
-_probe_results: dict = {}
+def _probe_compiles(call, arg_shapes, *, aggressive: bool) -> bool:
+    """AOT-compile one candidate's ``pallas_call`` (fresh ShapeDtypeStructs,
+    no tracers — safe inside an outer trace) and classify the outcome:
+
+    - compiles: the candidate is legal;
+    - a recognized VMEM-overflow wording: infeasible, the autotuner walks to
+      the next-ranked candidate;
+    - an UNCLASSIFIED compile error at an ``aggressive`` candidate (one
+      ranked cheaper than the analytic arithmetic's own pick — a jaxlib may
+      word its overflow in a way ``_looks_like_vmem_overflow`` does not
+      know): warn and treat as infeasible, so selection degrades to the
+      arithmetic's refuge instead of dying (ADVICE r4 #1);
+    - an unclassified error AT or BELOW the analytic pick: a genuine kernel
+      bug — re-raise rather than silently routing the shape off-kernel.
+    """
+    try:
+        jax.jit(call).lower(*arg_shapes).compile()
+        return True
+    except Exception as e:  # noqa: BLE001 - classified below
+        if _looks_like_vmem_overflow(e):
+            return False
+        if aggressive:
+            import logging
+            logging.getLogger(__name__).warning(
+                "autotune compile probe: unclassified compile error at an "
+                "aggressive candidate; treating as infeasible and walking "
+                "to the analytic refuge. Error: %s", e,
+            )
+            return False
+        raise
 
 
 def _fused_bwd_hc(B, L, H, D, in_dtype, mask_dtype, out_dtype, rate,
                   interpret) -> int:
-    """Head-chunk choice for the fused backward: full accounting against the
-    measured scoped-VMEM ceiling, then a cached compile probe on real TPU —
-    if Mosaic rejects the arithmetic's pick, halve to the next legal chunk
-    (VERDICT r3 #3: feasibility must not depend on a comment).
+    """Head-chunk choice for the fused backward, through the autotuner: on
+    real TPU every candidate is ranked by modeled cost and validated with a
+    cached compile probe (VERDICT r3 #3: feasibility must not depend on a
+    comment); interpret/CPU keeps the aggressive-budget arithmetic pick
+    (nothing to probe: interpret mode cannot OOM VMEM).
 
     The probe AOT-compiles the SAME pallas_call the execution path uses
     (fresh ShapeDtypeStructs, no tracers) at B=1 — scoped VMEM is
     B-independent (B is only a grid dimension), so one verdict covers every
-    batch size — and is cached per geometry, amortized further by the
-    persistent compilation cache across processes.
+    batch size — and winners persist in the on-disk tuning cache, amortized
+    further by the persistent compilation cache across processes.
+
+    An unclassified compile error at a candidate MORE aggressive than the
+    conservative 12 MB paper-budget pick is abandoned with a warning (the
+    walk reaches the conservative refuge next); at or below that pick it is
+    a genuine kernel bug and raises (ADVICE r4 #1).
     """
     itemsize = jnp.dtype(in_dtype).itemsize
-    hc = _pick_head_chunk(
-        H, D,
-        bytes_per_head=_fused_bwd_bytes_per_head(
-            L, D, itemsize, jnp.dtype(out_dtype).itemsize
-        ),
-        temp_bytes=_FUSED_BWD_TEMPS * L * L * 4,
-        budget=_VMEM_BUDGET_FUSED_BWD,
-    )
-    if interpret or jax.default_backend() != "tpu":
-        return hc  # nothing to probe: interpret mode cannot OOM VMEM
+    out_isz = jnp.dtype(out_dtype).itemsize
 
-    # the pick the old conservative 12 MB paper budget would have made: the
-    # refuge for an UNCLASSIFIED compile error at an aggressive pick (a
-    # jaxlib that words its VMEM overflow in a way _looks_like_vmem_overflow
-    # does not know). A genuine kernel bug reproduces at this pick too and
-    # still raises (ADVICE r4 #1).
-    conservative = _pick_head_chunk(
-        H, D,
-        bytes_per_head=_fused_bwd_bytes_per_head(
-            L, D, itemsize, jnp.dtype(out_dtype).itemsize
-        ),
-        temp_bytes=_FUSED_BWD_TEMPS * L * L * 4,
-        budget=_VMEM_BUDGET,
-    )
+    def pick(budget):
+        return _pick_head_chunk(
+            H, D,
+            bytes_per_head=_fused_bwd_bytes_per_head(L, D, itemsize, out_isz),
+            temp_bytes=_FUSED_BWD_TEMPS * L * L * 4,
+            budget=budget,
+        )
 
-    legal = sorted(_legal_head_chunks(H, D))
-    while True:
-        key = (L, H, D, str(in_dtype), str(mask_dtype), str(out_dtype),
-               rate > 0.0, hc)
-        ok = _probe_results.get(key)
-        if ok is None:
-            args = [
-                jax.ShapeDtypeStruct((1,), jnp.int32),          # row seeds
-                jax.ShapeDtypeStruct((1, 1, L), mask_dtype),    # mask
-                *[jax.ShapeDtypeStruct((1, L, H * D), in_dtype)] * 4,  # qkvg
-                jax.ShapeDtypeStruct((1, L, H * D), out_dtype),  # out
-                jax.ShapeDtypeStruct((1, 1, 1, H * L), jnp.float32),  # lse
-            ]
-            call = _build_fused_bwd_call(1, L, H, D, in_dtype, rate, hc,
-                                         interpret=False)
-            try:
-                jax.jit(call).lower(*args).compile()
-                ok = True
-            except Exception as e:  # noqa: BLE001 - classified below
-                if _looks_like_vmem_overflow(e):
-                    ok = False
-                elif hc > conservative:
-                    # warn loudly: this may be a genuinely hc-dependent
-                    # compile bug, not an unrecognized overflow wording — if
-                    # it is, it reproduces at the conservative pick and
-                    # raises there; if it is not, the operator should still
-                    # know the aggressive pick was abandoned and why
-                    import logging
-                    logging.getLogger(__name__).warning(
-                        "fused-bwd compile probe: unclassified compile error "
-                        "at hc=%d (aggressive budget); retrying at the "
-                        "conservative 12 MB-budget pick hc=%d. Error: %s",
-                        hc, conservative, e,
-                    )
-                    _probe_results[key] = False
-                    hc = conservative
-                    continue
-                else:
-                    raise
-            _probe_results[key] = ok
-        if ok:
-            return hc
-        smaller = [c for c in legal if c < hc]
-        if not smaller:
-            return hc  # no fallback left: let Mosaic fail loudly downstream
-        hc = max(smaller)
+    def analytic():
+        if not interpret and jax.default_backend() == "tpu":
+            # probing unavailable (autotune disabled): without the probe
+            # backstop the aggressive ceiling budget is unsafe — take the
+            # conservative paper-budget pick
+            return pick(_VMEM_BUDGET)
+        return pick(_VMEM_BUDGET_FUSED_BWD)
+
+    def cost(hc):
+        return H // hc
+
+    def probe(hc):
+        conservative = pick(_VMEM_BUDGET)
+        args = [
+            jax.ShapeDtypeStruct((1,), jnp.int32),          # row seeds
+            jax.ShapeDtypeStruct((1, 1, L), mask_dtype),    # mask
+            *[jax.ShapeDtypeStruct((1, L, H * D), in_dtype)] * 4,  # qkvg
+            jax.ShapeDtypeStruct((1, L, H * D), out_dtype),  # out
+            jax.ShapeDtypeStruct((1, 1, 1, H * L), jnp.float32),  # lse
+        ]
+        call = _build_fused_bwd_call(1, L, H, D, in_dtype, rate, hc,
+                                     interpret=False)
+        return _probe_compiles(call, args,
+                               aggressive=cost(hc) < cost(conservative))
+
+    hc = autotune.get().select(
+        "fused_bwd",
+        L=L, H=H, D=D, in_dtype=jnp.dtype(in_dtype), out_dtype=out_dtype,
+        dropout=rate > 0.0, extra=f"mask{jnp.dtype(mask_dtype)}",
+        candidates=sorted(_legal_head_chunks(H, D), reverse=True),
+        cost=cost, probe=probe, analytic=analytic, interpret=interpret,
+    )
+    # no candidate compiled: smallest legal chunk, let Mosaic fail loudly
+    # downstream (the old walk-down's terminal behavior)
+    return hc if hc is not None else min(_legal_head_chunks(H, D))
 
 
 def _flash_backward(q, k, v, mask, seed, g, out, lse, dtype, rate,
@@ -752,26 +850,96 @@ def _blocked_fwd_cfg(L: int, H: int, D: int, in_itemsize: int,
     return None
 
 
-def supports_blocked_fwd(L: int, H: int, D: int, in_itemsize: int,
-                         out_itemsize: int, rate: float = 0.0) -> bool:
-    """True when the q-blocked forward has a VMEM-feasible configuration
-    for this exact shape/dtype geometry (no defaults: a bert-base answer
-    for a different geometry would be silently wrong)."""
-    return (
-        L > _FUSED_BWD_MAX_LEN
-        and _blocked_fwd_cfg(L, H, D, in_itemsize, out_itemsize, rate)
-        is not None
+def _blocked_candidates(L: int, H: int, D: int):
+    """All (q_blk, hc) geometry candidates of the q-blocked regime (the
+    autotuner's enumeration; the analytic cfgs walk the same space)."""
+    q_blks = [blk for blk in (512, 256, 128) if L % blk == 0]
+    if not q_blks and L <= 512:
+        q_blks = [L]
+    return [(q_blk, hc) for q_blk in q_blks
+            for hc in sorted(_legal_head_chunks(H, D), reverse=True)]
+
+
+def _blocked_cost(L: int, H: int, D: int):
+    """Modeled step cost of a (q_blk, hc) candidate: grid programs dominate
+    (K/V stay resident per (b, hj), so HBM traffic is nearly geometry-
+    invariant); ties break toward larger head chunks (wider MXU feeds)."""
+    def cost(geom):
+        q_blk, hc = geom
+        return ((H // hc) * (L // q_blk), H // hc)
+    return cost
+
+
+def _blocked_fwd_geometry(L, H, D, in_dtype, out_dtype, rate,
+                          mask_dtype=jnp.int32, interpret=False):
+    """(q_blk, hc) for the q-blocked forward through the autotuner, or
+    ``None`` when no configuration is legal. Probed WITH the lse wire
+    output (the training superset — the analytic cfg counts it always for
+    the same reason)."""
+    in_isz = jnp.dtype(in_dtype).itemsize
+    out_isz = jnp.dtype(out_dtype).itemsize
+
+    def analytic():
+        return _blocked_fwd_cfg(L, H, D, in_isz, out_isz, rate)
+
+    cost = _blocked_cost(L, H, D)
+
+    def probe(geom):
+        q_blk, hc = geom
+        args = [
+            jax.ShapeDtypeStruct((1,), jnp.int32),          # row seeds
+            jax.ShapeDtypeStruct((1, 1, L), mask_dtype),    # mask
+            *[jax.ShapeDtypeStruct((1, L, H * D), in_dtype)] * 3,  # q k v
+        ]
+        call = _build_blocked_fwd_call(1, L, H, D, in_dtype, out_dtype,
+                                       rate, q_blk, hc, interpret=False,
+                                       want_lse=True)
+        ref = analytic()
+        return _probe_compiles(
+            call, args,
+            aggressive=ref is None or cost(geom) < cost(ref),
+        )
+
+    return autotune.get().select(
+        "blocked_fwd",
+        L=L, H=H, D=D, in_dtype=jnp.dtype(in_dtype), out_dtype=out_dtype,
+        dropout=rate > 0.0, extra=f"mask{jnp.dtype(mask_dtype)}",
+        candidates=_blocked_candidates(L, H, D), cost=cost, probe=probe,
+        analytic=analytic, interpret=interpret,
     )
 
 
-def _blocked_forward(q, k, v, mask, seed, q_blk, hc, dtype, rate,
-                     interpret: bool, want_lse: bool = False):
-    B, L, H, D = q.shape
+def supports_blocked_fwd(L: int, H: int, D: int, in_itemsize: int,
+                         out_itemsize: int, rate: float = 0.0,
+                         in_dtype=None, out_dtype=None,
+                         mask_dtype=jnp.int32) -> bool:
+    """True when the q-blocked forward has a feasible configuration for
+    this exact shape/dtype geometry (no defaults: a bert-base answer for a
+    different geometry would be silently wrong). On TPU the answer is the
+    autotuner's (compile-probe-validated, cached); elsewhere the analytic
+    arithmetic, unchanged. Optional ``in_dtype``/``out_dtype``/``mask_dtype``
+    refine the probe key to match the execution path's (derived from the
+    itemsizes / int32 when absent) — a dispatcher answer keyed differently
+    from the execution selection could disagree with it."""
+    if L <= _FUSED_BWD_MAX_LEN:
+        return False
+    return _blocked_fwd_geometry(
+        L, H, D,
+        _dtype_for_itemsize(in_itemsize, in_dtype),
+        _dtype_for_itemsize(out_itemsize, out_dtype),
+        rate,
+        mask_dtype=mask_dtype,
+    ) is not None
 
+
+def _build_blocked_fwd_call(B, L, H, D, in_dtype, out_dtype, rate, q_blk,
+                            hc, interpret, want_lse):
+    """The q-blocked forward ``pallas_call`` for one geometry, shared by the
+    execution path and the autotuner's compile probe so they cannot drift."""
     out_specs = [
         pl.BlockSpec((1, q_blk, hc * D), lambda b, hj, qi, *_: (b, qi, hj))
     ]
-    out_shape = [jax.ShapeDtypeStruct((B, L, H * D), dtype)]
+    out_shape = [jax.ShapeDtypeStruct((B, L, H * D), out_dtype)]
     if want_lse:
         # head-major wire layout (see _lse_pack): qb = q_blk here
         out_specs.append(
@@ -785,7 +953,7 @@ def _blocked_forward(q, k, v, mask, seed, q_blk, hc, dtype, rate,
     # q-blocks INNERMOST: the k/v index map is constant in qi, so Pallas
     # keeps each head-group's full K/V resident across all q-blocks instead
     # of re-streaming them L/q_blk times from HBM.
-    res = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(_blocked_fwd_kernel, scale=1.0 / (D ** 0.5),
                           rate=rate, hc=hc, D=D),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -801,7 +969,16 @@ def _blocked_forward(q, k, v, mask, seed, q_blk, hc, dtype, rate,
         ),
         out_shape=out_shape,
         interpret=interpret,
-    )(_row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v))
+    )
+
+
+def _blocked_forward(q, k, v, mask, seed, q_blk, hc, dtype, rate,
+                     interpret: bool, want_lse: bool = False):
+    B, L, H, D = q.shape
+    res = _build_blocked_fwd_call(B, L, H, D, q.dtype, dtype, rate, q_blk,
+                                  hc, interpret, want_lse)(
+        _row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v)
+    )
     if want_lse:
         return res[0].reshape(B, L, H, D), _lse_unpack(res[1], q_blk, H)
     return res[0].reshape(B, L, H, D)
@@ -848,28 +1025,81 @@ def _blocked_bwd_cfg(L: int, H: int, D: int, in_itemsize: int,
     return None
 
 
-def supports_blocked_bwd(L: int, H: int, D: int, in_itemsize: int,
-                         rate: float = 0.0,
-                         out_itemsize: int | None = None) -> bool:
-    """True when the fused q-blocked backward has a VMEM-feasible
-    configuration for this exact head geometry and input/output itemsizes
-    (no defaults: a bert-base answer for a different geometry would be
-    silently wrong)."""
-    return (
-        L > _FUSED_BWD_MAX_LEN
-        and _blocked_bwd_cfg(L, H, D, in_itemsize, rate,
-                             out_itemsize=out_itemsize) is not None
+def _blocked_bwd_geometry(L, H, D, in_dtype, rate, out_dtype=None,
+                          mask_dtype=jnp.int32, interpret=False):
+    """(q_blk, hc) for the fused q-blocked backward through the autotuner,
+    or ``None`` when no configuration is legal (the caller then falls back
+    to the XLA-recompute backward)."""
+    in_isz = jnp.dtype(in_dtype).itemsize
+    out_dtype = jnp.dtype(out_dtype) if out_dtype is not None else jnp.dtype(in_dtype)
+
+    def analytic():
+        return _blocked_bwd_cfg(L, H, D, in_isz, rate,
+                                out_itemsize=out_dtype.itemsize)
+
+    cost = _blocked_cost(L, H, D)
+
+    def probe(geom):
+        q_blk, hc = geom
+        args = [
+            jax.ShapeDtypeStruct((1,), jnp.int32),          # row seeds
+            jax.ShapeDtypeStruct((1, 1, L), mask_dtype),    # mask
+            *[jax.ShapeDtypeStruct((1, L, H * D), in_dtype)] * 4,  # q k v g
+            jax.ShapeDtypeStruct((1, L, H * D), out_dtype),  # out residual
+            jax.ShapeDtypeStruct((1, L // q_blk, 1, H * q_blk),
+                                 jnp.float32),               # lse wire
+        ]
+        call = _build_blocked_bwd_call(1, L, H, D, in_dtype, rate, q_blk,
+                                       hc, interpret=False)
+        ref = analytic()
+        return _probe_compiles(
+            call, args,
+            aggressive=ref is None or cost(geom) < cost(ref),
+        )
+
+    return autotune.get().select(
+        "blocked_bwd",
+        L=L, H=H, D=D, in_dtype=jnp.dtype(in_dtype), out_dtype=out_dtype,
+        dropout=rate > 0.0, extra=f"mask{jnp.dtype(mask_dtype)}",
+        candidates=_blocked_candidates(L, H, D), cost=cost, probe=probe,
+        analytic=analytic, interpret=interpret,
     )
 
 
-def _blocked_backward(q, k, v, mask, seed, g, out, lse, q_blk, hc, dtype,
-                      rate, interpret: bool):
-    B, L, H, D = q.shape
+def supports_blocked_bwd(L: int, H: int, D: int, in_itemsize: int,
+                         rate: float = 0.0,
+                         out_itemsize: int | None = None,
+                         in_dtype=None, out_dtype=None,
+                         mask_dtype=jnp.int32) -> bool:
+    """True when the fused q-blocked backward has a feasible configuration
+    for this exact head geometry and input/output itemsizes (no defaults: a
+    bert-base answer for a different geometry would be silently wrong). On
+    TPU the answer is the autotuner's (compile-probe-validated, cached);
+    elsewhere the analytic arithmetic, unchanged. The optional dtypes key
+    the probe identically to the execution path's selection."""
+    if L <= _FUSED_BWD_MAX_LEN:
+        return False
+    return _blocked_bwd_geometry(
+        L, H, D,
+        _dtype_for_itemsize(in_itemsize, in_dtype),
+        rate,
+        out_dtype=_dtype_for_itemsize(
+            out_itemsize if out_itemsize is not None else in_itemsize,
+            out_dtype,
+        ),
+        mask_dtype=mask_dtype,
+    ) is not None
 
+
+def _build_blocked_bwd_call(B, L, H, D, in_dtype, rate, q_blk, hc,
+                            interpret):
+    """The q-blocked backward ``pallas_call`` for one geometry, shared by
+    the execution path and the autotuner's compile probe so they cannot
+    drift."""
     spec_q = pl.BlockSpec((1, q_blk, hc * D), lambda b, hj, qi, *_: (b, qi, hj))
     spec_l = pl.BlockSpec((1, L, hc * D), lambda b, hj, qi, *_: (b, 0, hj))
 
-    dq, dk, dv = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(_blocked_bwd_kernel, scale=1.0 / (D ** 0.5),
                           rate=rate, hc=hc, D=D),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -887,13 +1117,21 @@ def _blocked_backward(q, k, v, mask, seed, g, out, lse, q_blk, hc, dtype,
             out_specs=[spec_q, spec_l, spec_l],
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((B, L, H * D), q.dtype),      # dq
+            jax.ShapeDtypeStruct((B, L, H * D), in_dtype),     # dq
             jax.ShapeDtypeStruct((B, L, H * D), jnp.float32),  # dk (f32 acc)
             jax.ShapeDtypeStruct((B, L, H * D), jnp.float32),  # dv (f32 acc)
         ],
         interpret=interpret,
-    )(_row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v),
-      _fold(g), _fold(out), _lse_pack(lse, q_blk))
+    )
+
+
+def _blocked_backward(q, k, v, mask, seed, g, out, lse, q_blk, hc, dtype,
+                      rate, interpret: bool):
+    B, L, H, D = q.shape
+    dq, dk, dv = _build_blocked_bwd_call(B, L, H, D, q.dtype, rate, q_blk,
+                                         hc, interpret)(
+        _row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v),
+        _fold(g), _fold(out), _lse_pack(lse, q_blk))
     return (
         dq.reshape(B, L, H, D),
         dk.reshape(B, L, H, D).astype(k.dtype),
@@ -912,10 +1150,11 @@ def _xla_reference(q, k, v, mask, dtype):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
 def _flash_core(q, k, v, mask, seed, dtype, rate, interpret):
     B, L, H, D = q.shape
-    if supports_fused_bwd(L):
+    if supports_fused_bwd(L, interpret):
         return _flash_forward(q, k, v, mask, seed, dtype, rate, interpret)
-    cfg = _blocked_fwd_cfg(
-        L, H, D, q.dtype.itemsize, jnp.dtype(dtype).itemsize, rate
+    cfg = _blocked_fwd_geometry(
+        L, H, D, q.dtype, jnp.dtype(dtype), rate, mask_dtype=mask.dtype,
+        interpret=interpret,
     )
     if cfg is None:
         raise ValueError(
@@ -928,7 +1167,7 @@ def _flash_core(q, k, v, mask, seed, dtype, rate, interpret):
 
 def _fwd(q, k, v, mask, seed, dtype, rate, interpret):
     B, L, H, D = q.shape
-    if supports_fused_bwd(L):
+    if supports_fused_bwd(L, interpret):
         # the forward also emits per-row logsumexp so the backward skips
         # the max/sum/divide normalization sweeps; the output itself is a
         # residual too (delta identity row term) — XLA already keeps it
@@ -938,10 +1177,13 @@ def _fwd(q, k, v, mask, seed, dtype, rate, interpret):
             q, k, v, mask, seed, dtype, rate, interpret, want_lse=True
         )
         return out, (q, k, v, mask, seed, out, lse)
-    if supports_blocked_bwd(L, H, D, q.dtype.itemsize, rate,
-                            out_itemsize=jnp.dtype(dtype).itemsize):
-        cfg = _blocked_fwd_cfg(
-            L, H, D, q.dtype.itemsize, jnp.dtype(dtype).itemsize, rate
+    if L > _FUSED_BWD_MAX_LEN and _blocked_bwd_geometry(
+        L, H, D, q.dtype, rate, out_dtype=jnp.dtype(dtype),
+        mask_dtype=mask.dtype, interpret=interpret,
+    ) is not None:
+        cfg = _blocked_fwd_geometry(
+            L, H, D, q.dtype, jnp.dtype(dtype), rate, mask_dtype=mask.dtype,
+            interpret=interpret,
         )
         if cfg is not None:
             out, lse = _blocked_forward(
@@ -956,15 +1198,17 @@ def _fwd(q, k, v, mask, seed, dtype, rate, interpret):
 def _bwd(dtype, rate, interpret, residuals, g):
     q, k, v, mask, seed, out, lse = residuals
     L, H, D = q.shape[1], q.shape[2], q.shape[3]
-    if supports_fused_bwd(L):
+    if supports_fused_bwd(L, interpret):
         dq, dk, dv = _flash_backward(
             q, k, v, mask, seed, g.astype(q.dtype), out, lse, dtype, rate,
             interpret,
         )
         return dq, dk, dv, None, None
     if L > _FUSED_BWD_MAX_LEN and lse is not None:
-        cfg = _blocked_bwd_cfg(L, H, D, q.dtype.itemsize, rate,
-                               out_itemsize=jnp.dtype(dtype).itemsize)
+        cfg = _blocked_bwd_geometry(
+            L, H, D, q.dtype, rate, out_dtype=jnp.dtype(dtype),
+            mask_dtype=mask.dtype, interpret=interpret,
+        )
         if cfg is not None:
             dq, dk, dv = _blocked_backward(
                 q, k, v, mask, seed, g.astype(q.dtype), out, lse, *cfg,

@@ -41,13 +41,16 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import autotune
 from .flash_attention import (
     _NEG_INF,
     _VMEM_BUDGET,
+    _dtype_for_itemsize,
     _fold,
     _legal_head_chunks,
     _lse_pack,
     _lse_unpack,
+    _probe_compiles,
     _row_seeds,
     _sublane8,
     _uniform_grid,
@@ -96,12 +99,85 @@ def streaming_cfg(L: int, H: int, D: int, in_itemsize: int,
     return None
 
 
+def _stream_candidates(L: int, H: int, D: int):
+    """All (blk, hc) candidates of the streaming regime (the autotuner's
+    enumeration; ``streaming_cfg`` walks the same space analytically)."""
+    blks = [blk for blk in (512, 256, 128) if L % blk == 0 and L // blk >= 2]
+    return [(blk, hc) for blk in blks
+            for hc in sorted(_legal_head_chunks(H, D), reverse=True)]
+
+
+def _streaming_geometry(L, H, D, in_dtype, out_dtype, rate,
+                        mask_dtype=None, interpret=False):
+    """(blk, hc) for the streaming kernels through the autotuner, or
+    ``None``. One geometry serves both directions, so the probe compiles
+    the forward AND the heavier dk/dv backward — a candidate is legal only
+    when both lower."""
+    in_isz = jnp.dtype(in_dtype).itemsize
+    out_isz = jnp.dtype(out_dtype).itemsize
+    mask_dtype = jnp.dtype(mask_dtype) if mask_dtype is not None else (
+        jnp.dtype(jnp.int32)
+    )
+
+    def analytic():
+        return streaming_cfg(L, H, D, in_isz, out_isz, rate)
+
+    def cost(geom):
+        blk, hc = geom
+        # k/v re-stream once per q block: HBM traffic and program count both
+        # scale with (L/blk); ties break toward larger head chunks
+        return ((L // blk) * (H // hc), H // hc)
+
+    def probe(geom):
+        blk, hc = geom
+        ref = analytic()
+        aggressive = ref is None or cost(geom) < cost(ref)
+        fwd_args = [
+            jax.ShapeDtypeStruct((1,), jnp.int32),          # row seeds
+            jax.ShapeDtypeStruct((1, 1, L), mask_dtype),    # mask
+            *[jax.ShapeDtypeStruct((1, L, H * D), in_dtype)] * 3,  # q k v
+        ]
+        fwd = _build_stream_fwd_call(1, L, H, D, in_dtype, out_dtype, rate,
+                                     blk, hc, interpret=False)
+        if not _probe_compiles(fwd, fwd_args, aggressive=aggressive):
+            return False
+        dkv_args = [
+            jax.ShapeDtypeStruct((1,), jnp.int32),          # row seeds
+            jax.ShapeDtypeStruct((1, 1, L), mask_dtype),    # mask
+            *[jax.ShapeDtypeStruct((1, L, H * D), in_dtype)] * 4,  # k v q g
+            jax.ShapeDtypeStruct((1, L, H * D), out_dtype),  # out residual
+            jax.ShapeDtypeStruct((1, L // blk, 1, H * blk), jnp.float32),
+        ]
+        dkv = _build_stream_dkv_call(1, L, H, D, in_dtype, rate, blk, hc,
+                                     interpret=False)
+        return _probe_compiles(dkv, dkv_args, aggressive=aggressive)
+
+    return autotune.get().select(
+        "stream",
+        L=L, H=H, D=D, in_dtype=jnp.dtype(in_dtype), out_dtype=out_dtype,
+        dropout=rate > 0.0, extra=f"mask{mask_dtype}",
+        candidates=_stream_candidates(L, H, D), cost=cost, probe=probe,
+        analytic=analytic, interpret=interpret,
+    )
+
+
 def supports_streaming(L: int, H: int, D: int, in_itemsize: int,
-                       out_itemsize: int, rate: float = 0.0) -> bool:
+                       out_itemsize: int, rate: float = 0.0,
+                       in_dtype=None, out_dtype=None,
+                       mask_dtype=None) -> bool:
     """True when the streaming regime applies: a legal block geometry that
-    fits VMEM. Both directions share one (blk, hc) config, so — unlike the
-    q-blocked regime — dropout needs no second feasibility check."""
-    return streaming_cfg(L, H, D, in_itemsize, out_itemsize, rate) is not None
+    fits VMEM — the autotuner's compile-probe-validated answer on TPU, the
+    analytic arithmetic elsewhere. Both directions share one (blk, hc)
+    config, so — unlike the q-blocked regime — dropout needs no second
+    feasibility check. The optional dtypes key the probe identically to
+    the execution path's selection."""
+    return _streaming_geometry(
+        L, H, D,
+        _dtype_for_itemsize(in_itemsize, in_dtype),
+        _dtype_for_itemsize(out_itemsize, out_dtype),
+        rate,
+        mask_dtype=mask_dtype,
+    ) is not None
 
 
 def _keep_tile(seed_ref, b, bh, L, blk, qi, ki, rate):
@@ -275,11 +351,14 @@ def _stream_dkv_kernel(seed_ref, mask_ref, k_ref, v_ref, q_ref, g_ref,
             dv_ref[0, :, sl] = dv_acc.astype(dv_ref.dtype)
 
 
-def _stream_forward(q, k, v, mask, seed, blk, hc, dtype, rate, interpret):
-    B, L, H, D = q.shape
+def _build_stream_fwd_call(B, L, H, D, in_dtype, out_dtype, rate, blk, hc,
+                           interpret):
+    """The streaming forward ``pallas_call`` for one (blk, hc), shared by
+    the execution path and the autotuner's compile probe so they cannot
+    drift."""
     spec_q = pl.BlockSpec((1, blk, hc * D), lambda b, hj, qi, ki, *_: (b, qi, hj))
     spec_k = pl.BlockSpec((1, blk, hc * D), lambda b, hj, qi, ki, *_: (b, ki, hj))
-    out, lse = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(_stream_fwd_kernel, scale=1.0 / (D ** 0.5),
                           rate=rate, hc=hc, D=D, L=L),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -301,11 +380,19 @@ def _stream_forward(q, k, v, mask, seed, blk, hc, dtype, rate, interpret):
             ],
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((B, L, H * D), dtype),
+            jax.ShapeDtypeStruct((B, L, H * D), out_dtype),
             jax.ShapeDtypeStruct((B, L // blk, 1, H * blk), jnp.float32),
         ],
         interpret=interpret,
-    )(_row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v))
+    )
+
+
+def _stream_forward(q, k, v, mask, seed, blk, hc, dtype, rate, interpret):
+    B, L, H, D = q.shape
+    out, lse = _build_stream_fwd_call(B, L, H, D, q.dtype, dtype, rate, blk,
+                                      hc, interpret)(
+        _row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v)
+    )
     return out.reshape(B, L, H, D), _lse_unpack(lse, blk, H)
 
 
@@ -340,9 +427,25 @@ def _stream_backward(q, k, v, mask, seed, g, out, lse, blk, hc, dtype, rate,
     # same residuals, transposed grid: k/v blocks resident, q sweeps
     dkv_args = (args[0], args[1], args[3], args[4], args[2], args[5],
                 args[6], args[7])
+    dk, dv = _build_stream_dkv_call(B, L, H, D, q.dtype, rate, blk, hc,
+                                    interpret, k_dtype=k.dtype,
+                                    v_dtype=v.dtype)(*dkv_args)
+    return (dq.reshape(B, L, H, D), dk.reshape(B, L, H, D),
+            dv.reshape(B, L, H, D))
+
+
+def _build_stream_dkv_call(B, L, H, D, in_dtype, rate, blk, hc, interpret,
+                           k_dtype=None, v_dtype=None):
+    """The streaming dk/dv ``pallas_call`` for one (blk, hc) — the heaviest
+    of the three streaming kernels (two f32 scratch accumulators), so it is
+    the one the autotuner probes alongside the forward. ``k_dtype`` /
+    ``v_dtype`` default to ``in_dtype`` (the probe's uniform-dtype shape);
+    the execution path passes the primals' own dtypes so the cotangents
+    match mixed-dtype q/k/v."""
+    scale = 1.0 / (D ** 0.5)
     spec_kq = pl.BlockSpec((1, blk, hc * D), lambda b, hj, ki, qi, *_: (b, ki, hj))
     spec_qq = pl.BlockSpec((1, blk, hc * D), lambda b, hj, ki, qi, *_: (b, qi, hj))
-    dk, dv = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(_stream_dkv_kernel, scale=scale, rate=rate, hc=hc,
                           D=D, L=L),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -361,13 +464,13 @@ def _stream_backward(q, k, v, mask, seed, g, out, lse, blk, hc, dtype, rate,
             ],
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((B, L, H * D), k.dtype),
-            jax.ShapeDtypeStruct((B, L, H * D), v.dtype),
+            jax.ShapeDtypeStruct((B, L, H * D),
+                                 k_dtype if k_dtype is not None else in_dtype),
+            jax.ShapeDtypeStruct((B, L, H * D),
+                                 v_dtype if v_dtype is not None else in_dtype),
         ],
         interpret=interpret,
-    )(*dkv_args)
-    return (dq.reshape(B, L, H, D), dk.reshape(B, L, H, D),
-            dv.reshape(B, L, H, D))
+    )
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
@@ -378,8 +481,8 @@ def _stream_core(q, k, v, mask, seed, dtype, rate, interpret):
 
 def _stream_fwd(q, k, v, mask, seed, dtype, rate, interpret):
     B, L, H, D = q.shape
-    cfg = streaming_cfg(L, H, D, q.dtype.itemsize, jnp.dtype(dtype).itemsize,
-                        rate)
+    cfg = _streaming_geometry(L, H, D, q.dtype, jnp.dtype(dtype), rate,
+                              mask_dtype=mask.dtype, interpret=interpret)
     if cfg is None:
         raise ValueError(
             f"no VMEM-feasible streaming config for L={L}, H={H}, D={D} "
@@ -393,8 +496,10 @@ def _stream_fwd(q, k, v, mask, seed, dtype, rate, interpret):
 def _stream_bwd(dtype, rate, interpret, residuals, g):
     q, k, v, mask, seed, out, lse = residuals
     B, L, H, D = q.shape
-    cfg = streaming_cfg(L, H, D, q.dtype.itemsize, jnp.dtype(dtype).itemsize,
-                        rate)
+    # same key as the forward's selection -> the cached geometry, so both
+    # directions always run the SAME (blk, hc)
+    cfg = _streaming_geometry(L, H, D, q.dtype, jnp.dtype(dtype), rate,
+                              mask_dtype=mask.dtype, interpret=interpret)
     dq, dk, dv = _stream_backward(
         q, k, v, mask, seed, g.astype(q.dtype), out, lse, *cfg, dtype, rate,
         interpret,

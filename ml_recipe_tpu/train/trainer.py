@@ -61,6 +61,40 @@ except Exception:  # noqa: BLE001
     tqdm = None
 
 
+def _device_hbm_bytes() -> Optional[int]:
+    """Per-device HBM capacity in bytes, or ``None`` when the backend does
+    not report one (CPU; some simulators) — the pre-flight planner then
+    stands down rather than guessing."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 - absent API = no limit knowledge
+        return None
+    if not stats:
+        return None
+    limit = stats.get("bytes_limit")
+    return int(limit) if limit else None
+
+
+def _preflight_bytes(memory_analysis) -> Optional[int]:
+    """Projected per-device HBM requirement of a compiled step: arguments +
+    outputs + temporaries, minus the donated-buffer aliasing (params and
+    optimizer state are donated, so their output copies reuse the argument
+    buffers). ``None`` when the analysis is unavailable or malformed — the
+    planner then stands down instead of acting on garbage."""
+    if memory_analysis is None:
+        return None
+    try:
+        need = (
+            int(memory_analysis.argument_size_in_bytes)
+            + int(memory_analysis.output_size_in_bytes)
+            + int(memory_analysis.temp_size_in_bytes)
+            - int(getattr(memory_analysis, "alias_size_in_bytes", 0))
+        )
+    except (AttributeError, TypeError, ValueError):
+        return None
+    return need if need > 0 else None
+
+
 def _console_str(meters: dict) -> str:
     return ", ".join(
         f"{k}: {v() if isinstance(v, AverageMeter) else v:.3e}" for k, v in meters.items()
@@ -138,6 +172,17 @@ class Trainer:
     # (with stacks dumped) for the supervisor to restart, instead of
     # wedging. None = zero overhead.
     watchdog: Any = None
+
+    # HBM pre-flight planner: before the first train step executes, lower
+    # and compile the jitted step once, read ``compiled.memory_analysis()``,
+    # and if the projected HBM requirement exceeds the device limit, raise
+    # ``batch_split`` (doubling, honoring the mesh data-axis divisibility)
+    # and re-plan — instead of dying in XLA allocation. This is what
+    # restores bert-large at its BASELINE-recorded batch-256 settings: the
+    # plan runs at split 8 instead of OOMing at split 4, and the decision is
+    # logged with before/after byte counts. No-op where the device reports
+    # no memory limit (CPU) or the analysis is unavailable.
+    hbm_preflight: bool = True
 
     def __post_init__(self):
         if self.mesh is None:
@@ -282,6 +327,8 @@ class Trainer:
 
         self._jit_train_step = None
         self._jit_eval_step = None
+        self._preflight_done = not self.hbm_preflight
+        self.preflight_report = None
 
     def init_opt_state(self):
         """(Re)initialize ``opt_state`` from ``self.optimizer``, honoring
@@ -362,6 +409,130 @@ class Trainer:
 
         return jax.tree_util.tree_map(split, tree)
 
+
+    # -- HBM pre-flight planner ------------------------------------------------
+
+    def _next_batch_split(self) -> Optional[int]:
+        """Smallest batch_split above the current one that still divides the
+        global batch AND the per-host local batch (``_split_micro`` splits
+        the local arrays, so a split legal globally but not locally would
+        assert on an 8-host run), and keeps the micro-batch divisible over
+        the mesh data axis (the same legality the constructor enforces).
+        ``None`` when no such split exists."""
+        data_size = int(
+            self.mesh.shape.get("data", 1) if hasattr(self.mesh, "shape") else 1
+        )
+        local_batch = self.train_batch_size // max(self.process_count, 1)
+        split = self.batch_split * 2
+        while split <= local_batch:
+            if (self.train_batch_size % split == 0
+                    and local_batch % split == 0
+                    and (self.train_batch_size // split) % max(data_size, 1)
+                    == 0):
+                return split
+            split *= 2
+        return None
+
+    def preflight_train_step(self, host_inputs, host_labels, *,
+                             compile_fn=None, limit_bytes=None):
+        """HBM pre-flight: lower + compile the jitted train step once at the
+        current ``batch_split``, read ``compiled.memory_analysis()``, and if
+        the projected per-device requirement exceeds the device HBM, raise
+        ``batch_split`` and re-plan — so an over-committed configuration
+        (bert-large at batch 256 / split 4) degrades to a running plan with
+        a logged decision instead of an XLA allocation failure.
+
+        ``host_inputs``/``host_labels`` are UNSPLIT host batches
+        ([B_local, ...] leaves, exactly what the dataloader yields). The
+        compiled executable is cached by jit, so the planning compile is
+        also the first step's compile — no double work. ``compile_fn`` /
+        ``limit_bytes`` exist for tests (mock the XLA memory analysis and
+        the device limit); both default to the real thing. Returns the
+        decision report dict (also kept as ``self.preflight_report``).
+        """
+        self._preflight_done = True
+        if not self.hbm_preflight:
+            return None
+        limit = limit_bytes if limit_bytes is not None else _device_hbm_bytes()
+        if limit is None:
+            logger.info(
+                "HBM pre-flight: device reports no memory limit; skipping."
+            )
+            return None
+
+        report = {
+            "limit_bytes": int(limit),
+            "batch_split_before": self.batch_split,
+            "batch_split": self.batch_split,
+            "bytes_before": None,
+            "bytes": None,
+            "applied": False,
+        }
+        while True:
+            if self._jit_train_step is None:
+                self._jit_train_step = self._build_train_step()
+            if compile_fn is not None:
+                compiled = compile_fn(self)
+            else:
+                inputs = self._global_batch(
+                    self._split_micro(host_inputs), leading_accum=True
+                )
+                labels = self._global_batch(
+                    self._split_micro(host_labels), leading_accum=True
+                )
+                compiled = self._jit_train_step.lower(
+                    self.params, self.opt_state, inputs, labels,
+                    self.global_step,
+                ).compile()
+            try:
+                analysis = compiled.memory_analysis()
+            except Exception as e:  # noqa: BLE001 - analysis is best-effort
+                logger.info("HBM pre-flight: memory_analysis unavailable "
+                            "(%s); skipping.", e)
+                break
+            need = _preflight_bytes(analysis)
+            if need is None:
+                logger.info(
+                    "HBM pre-flight: memory analysis unavailable; skipping."
+                )
+                break
+            report["bytes"] = int(need)
+            if report["bytes_before"] is None:
+                report["bytes_before"] = int(need)
+            if need <= limit:
+                if report["applied"]:
+                    logger.warning(
+                        "HBM pre-flight: raised batch_split %d -> %d "
+                        "(projected %.2f GB -> %.2f GB vs %.2f GB device "
+                        "HBM); proceeding with the raised split.",
+                        report["batch_split_before"], self.batch_split,
+                        report["bytes_before"] / 1e9, need / 1e9,
+                        limit / 1e9,
+                    )
+                break
+            new_split = self._next_batch_split()
+            if new_split is None:
+                logger.warning(
+                    "HBM pre-flight: step needs %.2f GB vs %.2f GB device "
+                    "HBM and batch_split %d cannot be raised further "
+                    "(train_batch_size %d); proceeding — XLA will decide.",
+                    need / 1e9, limit / 1e9, self.batch_split,
+                    self.train_batch_size,
+                )
+                break
+            logger.warning(
+                "HBM pre-flight: step at batch_split %d needs %.2f GB vs "
+                "%.2f GB device HBM; raising batch_split to %d.",
+                self.batch_split, need / 1e9, limit / 1e9, new_split,
+            )
+            self.batch_split = new_split
+            report["batch_split"] = new_split
+            report["applied"] = True
+            # the step closed over the old batch_split — rebuild
+            self._jit_train_step = None
+
+        self.preflight_report = report
+        return report
 
     # -- compiled steps --------------------------------------------------------
 
@@ -656,6 +827,10 @@ class Trainer:
             for step_i, (inputs, labels) in enumerate(iterator):
                 _fault("trainer.step")
                 tick(f"train step {self.global_step} (epoch {epoch_i})")
+                if not self._preflight_done:
+                    # first batch of the run: plan HBM before executing —
+                    # may raise batch_split and rebuild the jitted step
+                    self.preflight_train_step(inputs, labels)
                 if not trace_started and epoch_i == 1 and step_i == trace_from:
                     jax.profiler.start_trace(str(self.trace_dir))
                     trace_started = True

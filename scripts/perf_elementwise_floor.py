@@ -43,9 +43,15 @@ def _collect_op_profile(trace_dir: str):
     return json.loads(data) if isinstance(data, (str, bytes)) else data
 
 
+_CAPTURE_META = "capture_meta.json"
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
-    p.add_argument("--steps", type=int, default=3)
+    # default resolved below: 3 when capturing, the trace dir's recorded
+    # step count when replaying (ADVICE r5 #4: a replay divided by a
+    # DIFFERENT default step count silently reports wrong per-step numbers)
+    p.add_argument("--steps", type=int, default=None)
     p.add_argument("--warmup", type=int, default=2)
     p.add_argument("--seq_len", type=int, default=512)
     p.add_argument("--global_batch", type=int, default=256)
@@ -59,7 +65,30 @@ def main() -> int:
     args = p.parse_args()
 
     if args.trace_dir:
+        # replay: the step count MUST match the capture's, or every
+        # per-step number divides by the wrong N. Prefer the count the
+        # capture persisted; an old trace dir without one requires an
+        # explicit --steps.
+        meta_path = os.path.join(args.trace_dir, _CAPTURE_META)
+        if os.path.exists(meta_path):
+            with open(meta_path) as fh:
+                recorded = int(json.load(fh)["steps"])
+            if args.steps is not None and args.steps != recorded:
+                p.error(
+                    f"--steps {args.steps} contradicts the capture's "
+                    f"recorded step count {recorded} ({meta_path})"
+                )
+            args.steps = recorded
+        elif args.steps is None:
+            p.error(
+                "--trace_dir replay needs --steps: this trace dir has no "
+                f"{_CAPTURE_META} (captured before step counts were "
+                "persisted), and the default would silently divide by the "
+                "wrong step count"
+            )
         return _report(args, args.trace_dir)
+    if args.steps is None:
+        args.steps = 3
 
     import jax
     import jax.numpy as jnp
@@ -115,6 +144,10 @@ def main() -> int:
     }
 
     trace_dir = tempfile.mkdtemp(prefix="elementwise_floor_")
+    # persist the capture's step count so a later --trace_dir replay can
+    # recover the right per-step divisor without trusting a CLI default
+    with open(os.path.join(trace_dir, _CAPTURE_META), "w") as fh:
+        json.dump({"steps": args.steps}, fh)
     with mesh:
         inputs = trainer._global_batch(host_inputs, leading_accum=True)
         labels = trainer._global_batch(host_labels, leading_accum=True)

@@ -10,9 +10,19 @@ run() {
   local name="$1"; shift
   echo "=== $name: $*" >&2
   if "$@" > "artifacts/r4/$name.json.tmp" 2> "artifacts/r4/$name.log"; then
-    grep "^{" "artifacts/r4/$name.json.tmp" | tail -1 > "artifacts/r4/$name.json"
-    rm -f "artifacts/r4/$name.json.tmp"
-    echo "    -> artifacts/r4/$name.json: $(cat artifacts/r4/$name.json)" >&2
+    # a tool that exits 0 but prints no JSON line (the perf_attn_bwd
+    # mis-fire this script exists to fix) must be recorded as a FAILURE,
+    # not an empty "measurement" — check grep's own exit status before
+    # declaring success and deleting the raw output (ADVICE r5 #2)
+    if grep "^{" "artifacts/r4/$name.json.tmp" | tail -1 > "artifacts/r4/$name.json" \
+        && [ -s "artifacts/r4/$name.json" ]; then
+      rm -f "artifacts/r4/$name.json.tmp"
+      echo "    -> artifacts/r4/$name.json: $(cat artifacts/r4/$name.json)" >&2
+    else
+      echo "    FAILED: exit 0 but no JSON line (raw output kept in artifacts/r4/$name.failed)" >&2
+      rm -f "artifacts/r4/$name.json"
+      mv "artifacts/r4/$name.json.tmp" "artifacts/r4/$name.failed" 2>/dev/null || true
+    fi
   else
     echo "    FAILED (see artifacts/r4/$name.log)" >&2
     mv "artifacts/r4/$name.json.tmp" "artifacts/r4/$name.failed" 2>/dev/null || true

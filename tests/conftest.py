@@ -12,6 +12,16 @@ import os
 os.environ.setdefault("HF_HUB_OFFLINE", "1")
 os.environ.setdefault("TRANSFORMERS_OFFLINE", "1")
 
+# Geometry-autotuner tuning cache (ops/autotune.py): point it at a per-run
+# temp dir so tests — and the bench.py subprocess smokes, which inherit the
+# env — never write into the repo's artifacts/tuning/.
+if "MLRT_AUTOTUNE_CACHE" not in os.environ:
+    import tempfile
+
+    os.environ["MLRT_AUTOTUNE_CACHE"] = tempfile.mkdtemp(
+        prefix="mlrt_tuning_cache_"
+    )
+
 # Force (not setdefault: the environment may pin JAX_PLATFORMS to a TPU
 # backend) the CPU platform with 8 virtual devices for every test run.
 os.environ["JAX_PLATFORMS"] = "cpu"

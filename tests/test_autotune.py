@@ -1,0 +1,292 @@
+"""Geometry-autotuner tests (ops/autotune.py): on-disk cache round-trip,
+corrupt-cache recovery, probe-counter semantics (a cache hit performs ZERO
+compile probes), modeled-cost ranking, and CPU-fallback selection parity
+with the old analytic VMEM gates."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ml_recipe_tpu.ops import autotune
+
+pytestmark = pytest.mark.unit
+
+
+@pytest.fixture
+def tuner(tmp_path, monkeypatch):
+    """Fresh autotuner on a per-test cache dir, device-kind pinned so the
+    cache partition is deterministic."""
+    at = autotune.reset()
+    at.set_cache_dir(tmp_path / "tuning")
+    monkeypatch.setattr(autotune, "_device_kind", lambda: "FakeTPU v0")
+    yield at
+    autotune.reset()
+
+
+def _fake_tpu(monkeypatch):
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+
+def _select(at, *, probe=None, analytic=None, interpret=False,
+            regime="fused_bwd", candidates=(12, 6, 4, 2), dropout=False):
+    return at.select(
+        regime, L=512, H=12, D=64, in_dtype="bfloat16", out_dtype="bfloat16",
+        dropout=dropout, candidates=list(candidates),
+        cost=lambda hc: 12 // hc, probe=probe, analytic=analytic,
+        interpret=interpret,
+    )
+
+
+def test_probe_rank_order_and_winner(tuner, monkeypatch):
+    """Candidates are probed in ascending modeled-cost order; the first
+    that compiles wins (it is the model-optimal legal geometry)."""
+    _fake_tpu(monkeypatch)
+    probed = []
+
+    def probe(hc):
+        probed.append(hc)
+        return hc <= 6  # pretend only hc<=6 lowers
+
+    assert _select(tuner, probe=probe) == 6
+    assert probed == [12, 6]  # cost order, stopped at first legal
+    assert tuner.probe_count == 2
+
+
+def test_cache_round_trip_zero_probes_on_second_invocation(
+    tuner, tmp_path, monkeypatch,
+):
+    """Acceptance: a second invocation at the same key — even from a fresh
+    process (fresh autotuner, same disk cache) — performs zero compile
+    probes and reports a cache hit."""
+    _fake_tpu(monkeypatch)
+    assert _select(tuner, probe=lambda hc: hc <= 4) == 4
+    assert tuner.probe_count == 3
+    cache_file = tuner._cache_file("FakeTPU v0")
+    assert cache_file.exists()
+    payload = json.loads(cache_file.read_text())
+    assert payload["version"] == 1
+    (entry,) = payload["entries"].values()
+    assert entry == {"geometry": 4, "source": "probe"}
+
+    # same process, same key: memory hit
+    assert _select(tuner, probe=lambda hc: pytest.fail("probed on hit")) == 4
+    assert tuner.probe_count == 3 and tuner.hits == 1
+    assert tuner.session_summary()["cache"] == "miss"  # first decision probed
+
+    # "new process": fresh autotuner over the same disk cache
+    fresh = autotune.GeometryAutotuner(cache_dir=tuner.cache_dir)
+    assert _select(fresh, probe=lambda hc: pytest.fail("probed on hit")) == 4
+    assert fresh.probe_count == 0 and fresh.hits == 1
+    assert fresh.session_summary()["cache"] == "hit"
+
+
+def test_tuple_geometry_and_none_verdict_policies(tuner, monkeypatch):
+    """(q_blk, hc) tuples survive the JSON round trip; the 'no legal
+    candidate' verdict is SESSION-ONLY — served from memory within the
+    process (no duplicate probe walks) but never persisted, because a
+    transient probe-environment failure (host OOM classified as
+    candidate-infeasible) must not permanently route the shape off-kernel."""
+    _fake_tpu(monkeypatch)
+    cands = [(512, 12), (512, 6), (256, 12)]
+    got = tuner.select(
+        "blocked_fwd", L=1024, H=12, D=64, in_dtype="bf16", out_dtype="bf16",
+        dropout=False, candidates=cands,
+        cost=lambda g: (1024 // g[0]) * (12 // g[1]),
+        probe=lambda g: g == (256, 12),
+    )
+    assert got == (256, 12)
+
+    def select_stream(at, probe):
+        return at.select(
+            "stream", L=4096, H=12, D=64, in_dtype="bf16", out_dtype="bf16",
+            dropout=False, candidates=cands,
+            cost=lambda g: (4096 // g[0]) * (12 // g[1]), probe=probe,
+        )
+
+    assert select_stream(tuner, lambda g: False) is None
+    # in-process: the None verdict IS served (no duplicate walk)...
+    assert select_stream(
+        tuner, lambda g: pytest.fail("re-probed in-process")
+    ) is None
+
+    fresh = autotune.GeometryAutotuner(cache_dir=tuner.cache_dir)
+    assert fresh.select(
+        "blocked_fwd", L=1024, H=12, D=64, in_dtype="bf16", out_dtype="bf16",
+        dropout=False, candidates=cands,
+        cost=lambda g: (1024 // g[0]) * (12 // g[1]),
+        probe=lambda g: pytest.fail("probed on hit"),
+    ) == (256, 12)
+    assert fresh.probe_count == 0
+    # ...but a fresh process re-probes the None verdict (not on disk)
+    reprobed = []
+    assert select_stream(
+        fresh, lambda g: reprobed.append(g) or False
+    ) is None
+    assert len(reprobed) == len(cands)
+
+
+def test_corrupt_cache_recovery(tuner, monkeypatch):
+    """A truncated/garbage cache file degrades to re-probing (with a
+    warning), never to a crash — and the next winner rewrites it valid."""
+    _fake_tpu(monkeypatch)
+    cache_file = tuner._cache_file("FakeTPU v0")
+    cache_file.parent.mkdir(parents=True, exist_ok=True)
+    cache_file.write_text('{"version": 1, "entries": {trunca')  # torn write
+
+    probed = []
+    assert _select(tuner, probe=lambda hc: probed.append(hc) or True) == 12
+    assert probed == [12]  # cache unreadable -> really probed
+    # rewritten valid
+    payload = json.loads(cache_file.read_text())
+    assert list(payload["entries"].values())[0]["geometry"] == 12
+
+    # schema-invalid entries are dropped on load, valid ones kept
+    key = list(payload["entries"])[0]
+    payload["entries"]["bogus"] = {"geometry": "not-a-geometry"}
+    cache_file.write_text(json.dumps(payload))
+    fresh = autotune.GeometryAutotuner(cache_dir=tuner.cache_dir)
+    assert _select(fresh, probe=lambda hc: pytest.fail("valid entry lost")) == 12
+    assert key in fresh._entries["FakeTPU v0"]
+    assert "bogus" not in fresh._entries["FakeTPU v0"]
+
+
+def test_probe_exception_propagates_and_caches_nothing(tuner, monkeypatch):
+    """A probe that raises (unclassified compile error at the conservative
+    pick — a genuine kernel bug) must propagate, and the poisoned key must
+    NOT be cached as a verdict."""
+    _fake_tpu(monkeypatch)
+
+    def probe(hc):
+        raise RuntimeError("genuine kernel bug")
+
+    with pytest.raises(RuntimeError, match="genuine kernel bug"):
+        _select(tuner, probe=probe)
+    assert not tuner._entries.get("FakeTPU v0")
+
+
+def test_cpu_takes_analytic_and_caches(tuner):
+    """Off-TPU the probe must never run; the analytic pick is returned,
+    cached, and served as a hit on the second lookup."""
+    assert _select(
+        tuner,
+        probe=lambda hc: pytest.fail("probed on cpu"),
+        analytic=lambda: 6,
+    ) == 6
+    assert tuner.probe_count == 0 and tuner.misses == 1
+    assert _select(
+        tuner,
+        probe=lambda hc: pytest.fail("probed on cpu"),
+        analytic=lambda: pytest.fail("analytic re-ran on hit"),
+    ) == 6
+    assert tuner.hits == 1
+
+
+def test_probe_capable_lookup_upgrades_analytic_entries(tuner, monkeypatch):
+    """An interpret-mode run on a TPU host caches ANALYTIC picks under the
+    hardware device kind; a later compiled (probe-capable) run must NOT
+    serve them as hits — it re-selects via probe and overwrites, otherwise
+    the unvalidated arithmetic is back in charge on hardware."""
+    # interpret on the "TPU": analytic source, cached
+    _fake_tpu(monkeypatch)
+    assert _select(tuner, probe=lambda hc: pytest.fail("probed interpret"),
+                   analytic=lambda: 12, interpret=True) == 12
+    # compiled lookup at the same key: must probe, not trust the entry
+    probed = []
+    assert _select(tuner, probe=lambda hc: probed.append(hc) or hc <= 6) == 6
+    assert probed == [12, 6]
+    # ...and the upgraded probe verdict now serves compiled hits
+    assert _select(tuner, probe=lambda hc: pytest.fail("probed on hit")) == 6
+
+
+def test_cache_invalidated_on_toolchain_change(tuner, monkeypatch):
+    """Probe verdicts must not outlive the jax/jaxlib pair that issued them:
+    a cache written by another toolchain is ignored and re-probed."""
+    _fake_tpu(monkeypatch)
+    assert _select(tuner, probe=lambda hc: hc <= 6) == 6
+    cache_file = tuner._cache_file("FakeTPU v0")
+    payload = json.loads(cache_file.read_text())
+    assert payload["toolchain"] == autotune._toolchain()
+    payload["toolchain"] = "jax-0.0.1+jaxlib-0.0.1"
+    cache_file.write_text(json.dumps(payload))
+
+    fresh = autotune.GeometryAutotuner(cache_dir=tuner.cache_dir)
+    probed = []
+    assert _select(fresh, probe=lambda hc: probed.append(hc) or hc <= 6) == 6
+    assert probed == [12, 6]  # stale-toolchain entries were dropped
+
+
+def test_disabled_bypasses_cache_entirely(tuner, monkeypatch):
+    """--autotune off: pure analytic gating, no probes, no cache I/O."""
+    _fake_tpu(monkeypatch)
+    tuner.enabled = False
+    assert _select(
+        tuner, probe=lambda hc: pytest.fail("probed while disabled"),
+        analytic=lambda: 2,
+    ) == 2
+    assert tuner.probe_count == 0
+    assert not tuner._cache_file("FakeTPU v0").exists()
+    assert tuner.session_summary()["cache"] == "disabled"
+
+
+def test_cpu_selection_parity_with_old_analytic_gates(tuner):
+    """CPU fallback: the autotuned geometry selectors must agree EXACTLY
+    with the pre-autotuner analytic cfg functions across the shipped
+    geometry grid (tier-1 runs on CPU — selection there must not move)."""
+    from ml_recipe_tpu.ops import flash_attention as fa
+    from ml_recipe_tpu.ops import flash_streaming as fs
+
+    for L in (1024, 2048, 3072, 4096):
+        for isz, dt in ((2, jnp.bfloat16), (4, jnp.float32)):
+            for rate in (0.0, 0.1):
+                assert fa._blocked_fwd_geometry(
+                    L, 12, 64, dt, dt, rate
+                ) == fa._blocked_fwd_cfg(L, 12, 64, isz, isz, rate), (
+                    L, isz, rate, "blocked_fwd")
+                assert fa._blocked_bwd_geometry(
+                    L, 12, 64, dt, rate, out_dtype=dt
+                ) == fa._blocked_bwd_cfg(L, 12, 64, isz, rate,
+                                         out_itemsize=isz), (
+                    L, isz, rate, "blocked_bwd")
+                assert fs._streaming_geometry(
+                    L, 12, 64, dt, dt, rate
+                ) == fs.streaming_cfg(L, 12, 64, isz, isz, rate), (
+                    L, isz, rate, "stream")
+    # fused forward: selection equals the old _pick_head_chunk arithmetic
+    for L in (128, 256, 512):
+        for want_lse in (False, True):
+            hc = fa._fused_fwd_hc(1, L, 12, 64, jnp.bfloat16, jnp.int32,
+                                  jnp.bfloat16, 0.0, want_lse, False)
+            assert hc == fa._fused_fwd_analytic_hc(L, 12, 64, 2, 2, want_lse)
+    # fused backward off-TPU: the aggressive-budget arithmetic, as before
+    hc = fa._fused_bwd_hc(4, 512, 12, 64, jnp.bfloat16, jnp.int32,
+                          jnp.bfloat16, 0.0, interpret=True)
+    assert hc == fa._pick_head_chunk(
+        12, 64,
+        bytes_per_head=fa._fused_bwd_bytes_per_head(512, 64, 2, 2),
+        temp_bytes=fa._FUSED_BWD_TEMPS * 512 * 512 * 4,
+        budget=fa._VMEM_BUDGET_FUSED_BWD,
+    )
+
+
+def test_tuning_cache_smoke_end_to_end(tuner):
+    """Tier-1 smoke (ISSUE 2 satellite): a real flash_attention dispatch on
+    the CPU mesh populates the tuning cache through the selection path
+    (analytic source off-TPU, zero probes), and the second call hits."""
+    from ml_recipe_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 1024, 2, 64)),
+                           dtype=jnp.float32) for _ in range(3))
+    out = flash_attention(q, k, v, None, dtype=jnp.float32, interpret=True)
+    assert out.shape == (1, 1024, 2, 64)
+    assert tuner.probe_count == 0
+    assert tuner._cache_file("FakeTPU v0").exists()
+    decisions = tuner.session_summary()["decisions"]
+    assert any(d["regime"] == "blocked_fwd" for d in decisions.values())
+
+    flash_attention(q, k, v, None, dtype=jnp.float32, interpret=True)
+    assert tuner.hits >= 1

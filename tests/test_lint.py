@@ -1,0 +1,44 @@
+"""Tier-1 wiring for the repo's lint gates (ISSUE 2 satellite: the gates
+must run where the test tier runs, not only when an operator remembers the
+script)."""
+
+import subprocess
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.unit
+
+_REPO = Path(__file__).resolve().parents[1]
+
+
+def test_check_bare_except_gate_is_clean():
+    """scripts/check_bare_except.sh: a bare ``except:`` swallows
+    KeyboardInterrupt/SystemExit and turns the SIGTERM-to-checkpoint path,
+    the watchdog abort, and fault drills into silent no-ops — the package
+    must stay clean."""
+    script = _REPO / "scripts" / "check_bare_except.sh"
+    out = subprocess.run(
+        ["bash", str(script)], capture_output=True, text=True, timeout=120,
+        cwd=str(_REPO),
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "OK" in out.stdout
+
+
+def test_check_bare_except_catches_violations(tmp_path):
+    """The gate actually fires on a violation (a lint that cannot fail
+    would pass forever while protecting nothing)."""
+    pkg = tmp_path / "ml_recipe_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text("try:\n    pass\nexcept:\n    pass\n")
+    script_src = (_REPO / "scripts" / "check_bare_except.sh").read_text()
+    scripts = tmp_path / "scripts"
+    scripts.mkdir()
+    gate = scripts / "check_bare_except.sh"
+    gate.write_text(script_src)
+    out = subprocess.run(
+        ["bash", str(gate)], capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 1
+    assert "bad.py" in out.stdout

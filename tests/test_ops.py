@@ -525,13 +525,16 @@ def test_fused_bwd_accounting_no_excluded_terms():
 
 
 @pytest.mark.unit
-def test_fused_bwd_hc_probe_halves_on_vmem_overflow(monkeypatch):
-    """The compile probe must walk down the legal head chunks when Mosaic
-    rejects the arithmetic's pick, and cache the verdicts."""
+def test_fused_bwd_hc_probe_halves_on_vmem_overflow(monkeypatch, tmp_path):
+    """The autotuner's compile probe must walk down the cost-ranked legal
+    head chunks when Mosaic rejects a candidate, and cache the winner (so a
+    second call at the same key — any batch size — performs zero probes)."""
+    from ml_recipe_tpu.ops import autotune
     from ml_recipe_tpu.ops import flash_attention as fa
 
     monkeypatch.setattr(fa.jax, "default_backend", lambda: "tpu")
-    monkeypatch.setattr(fa, "_probe_results", {})
+    at = autotune.reset()
+    at.set_cache_dir(tmp_path / "walkdown")
 
     compiled = []
 
@@ -565,14 +568,20 @@ def test_fused_bwd_hc_probe_halves_on_vmem_overflow(monkeypatch):
     hc = fa._fused_bwd_hc(4, 512, 12, 64, jnp.bfloat16, jnp.int32,
                           jnp.bfloat16, 0.1, interpret=False)
     assert hc == 2
-    assert compiled == [6, 4, 2]  # walked down the legal chunks
+    # walked down ALL legal chunks in modeled-cost order (the autotuner no
+    # longer pre-gates candidates with the arithmetic — the probe is the
+    # selection mechanism, the arithmetic only the refuge marker)
+    assert compiled == [12, 6, 4, 2]
     # second call (different B): cached — feasibility is B-independent
     hc2 = fa._fused_bwd_hc(16, 512, 12, 64, jnp.bfloat16, jnp.int32,
                            jnp.bfloat16, 0.1, interpret=False)
-    assert hc2 == 2 and compiled == [6, 4, 2]
+    assert hc2 == 2 and compiled == [12, 6, 4, 2]
+    assert at.probe_count == 4 and at.hits == 1
 
-    # a non-VMEM compile error must NOT be swallowed
-    monkeypatch.setattr(fa, "_probe_results", {})
+    # a non-VMEM compile error at/below the conservative arithmetic pick
+    # must NOT be swallowed
+    at = autotune.reset()
+    at.set_cache_dir(tmp_path / "raise")
 
     class _FakeLoweredBoom(_FakeLowered):
         def compile(self):
@@ -586,21 +595,26 @@ def test_fused_bwd_hc_probe_halves_on_vmem_overflow(monkeypatch):
     with pytest.raises(RuntimeError, match="unrelated"):
         fa._fused_bwd_hc(4, 512, 12, 64, jnp.bfloat16, jnp.int32,
                          jnp.bfloat16, 0.1, interpret=False)
+    autotune.reset()  # drop the tmp-dir-backed singleton
 
 
 @pytest.mark.unit
 def test_fused_bwd_hc_unclassified_error_falls_back_to_conservative(
-    monkeypatch,
+    monkeypatch, tmp_path,
 ):
-    """ADVICE r4 #1: an UNRECOGNIZED compile-error wording at the aggressive
-    budget's pick must retry at the conservative 12 MB-budget pick (where it
-    compiles fine on a healthy toolchain) instead of raising; a genuine
-    kernel bug that reproduces at the conservative pick still raises (pinned
-    by test_fused_bwd_hc_probe_halves_on_vmem_overflow's tail)."""
+    """ADVICE r4 #1: an UNRECOGNIZED compile-error wording at a candidate
+    MORE aggressive than the conservative 12 MB-budget pick must be
+    abandoned with a warning — the cost-ranked walk then reaches the
+    conservative refuge, where a healthy toolchain compiles fine — instead
+    of raising; a genuine kernel bug that reproduces at the conservative
+    pick still raises (pinned by
+    test_fused_bwd_hc_probe_halves_on_vmem_overflow's tail)."""
+    from ml_recipe_tpu.ops import autotune
     from ml_recipe_tpu.ops import flash_attention as fa
 
     monkeypatch.setattr(fa.jax, "default_backend", lambda: "tpu")
-    monkeypatch.setattr(fa, "_probe_results", {})
+    at = autotune.reset()
+    at.set_cache_dir(tmp_path)
     # pin both budgets: the module-level ones are resolved from the
     # environment/artifact at import time, and the (12, 6) picks below are
     # only correct for this 18 MB-aggressive / 12 MB-conservative pair
@@ -637,11 +651,12 @@ def test_fused_bwd_hc_unclassified_error_falls_back_to_conservative(
 
     hc = fa._fused_bwd_hc(4, 512, 12, 64, jnp.bfloat16, jnp.int32,
                           jnp.bfloat16, 0.1, interpret=False)
-    # bert-base L=512 bf16: the pinned aggressive budget picks 12, the
-    # conservative 12 MB budget picks 6 — the fallback lands exactly there,
-    # not one step down
+    # bert-base L=512 bf16: the unclassified error at hc=12 (more aggressive
+    # than the conservative 12 MB-budget pick of 6) is abandoned with a
+    # warning and the walk lands exactly on the conservative refuge
     assert hc == 6
     assert compiled == [12, 6]
+    autotune.reset()  # drop the tmp-dir-backed singleton
 
 
 @pytest.mark.unit

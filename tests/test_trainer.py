@@ -763,3 +763,97 @@ def test_sharded_save_interrupted_swap_recovery(tmp_path):
     assert ckpt.is_dir() and (ckpt / "manifest.msgpack").exists()
     assert not os.path.exists(str(ckpt) + ".old")
     assert not os.path.exists(str(ckpt) + ".saving")
+
+
+# -- HBM pre-flight planner (ISSUE 2) ----------------------------------------
+
+
+class _FakeMemoryAnalysis:
+    """memory_analysis double: temp bytes shrink as batch_split grows —
+    the shape of the real activation-memory curve under accumulation."""
+
+    def __init__(self, split):
+        self.argument_size_in_bytes = 1_000
+        self.output_size_in_bytes = 500
+        self.temp_size_in_bytes = 8_000 // split
+        self.alias_size_in_bytes = 500
+
+
+class _FakeCompiled:
+    def __init__(self, split):
+        self._split = split
+
+    def memory_analysis(self):
+        return _FakeMemoryAnalysis(self._split)
+
+
+def _fake_compile_fn(compiles):
+    def compile_fn(trainer):
+        compiles.append(trainer.batch_split)
+        return _FakeCompiled(trainer.batch_split)
+    return compile_fn
+
+
+def test_hbm_preflight_raises_batch_split(tmp_path):
+    """Acceptance (ISSUE 2): given a step whose memory_analysis exceeds
+    device HBM, the pre-flight raises batch_split and proceeds — instead
+    of surfacing an XLA OOM — and the report carries before/after bytes."""
+    trainer, _ = _make_trainer(tmp_path, batch_split=1)
+    compiles = []
+    # split 1 needs 1000+500+8000-500 = 9000 > 5000; split 2 needs 5000 <= 5000
+    report = trainer.preflight_train_step(
+        None, None, compile_fn=_fake_compile_fn(compiles), limit_bytes=5_000,
+    )
+    assert trainer.batch_split == 2
+    assert compiles == [1, 2]  # re-planned once, at the raised split
+    assert report["applied"] is True
+    assert report["batch_split_before"] == 1 and report["batch_split"] == 2
+    assert report["bytes_before"] == 9_000 and report["bytes"] == 5_000
+    assert report["limit_bytes"] == 5_000
+    assert trainer.preflight_report is report
+    # the jitted step was rebuilt for the new split and is ready to run
+    assert trainer._jit_train_step is not None
+    assert trainer._preflight_done
+
+
+def test_hbm_preflight_noop_within_limit(tmp_path):
+    """A configuration that already fits leaves batch_split untouched and
+    compiles exactly once (the compile is also the first step's)."""
+    trainer, _ = _make_trainer(tmp_path, batch_split=2)
+    compiles = []
+    report = trainer.preflight_train_step(
+        None, None, compile_fn=_fake_compile_fn(compiles), limit_bytes=10_000,
+    )
+    assert trainer.batch_split == 2 and compiles == [2]
+    assert report["applied"] is False
+    assert report["bytes"] == report["bytes_before"] == 5_000
+
+
+def test_hbm_preflight_stops_at_mesh_divisibility(tmp_path):
+    """batch_split can only rise while the micro-batch still divides over
+    the mesh data axis (batch 16 over data:8 caps the split at 2); past
+    that the planner logs and proceeds — XLA gets the final word."""
+    trainer, _ = _make_trainer(tmp_path, batch_split=1)
+    compiles = []
+    report = trainer.preflight_train_step(
+        None, None, compile_fn=_fake_compile_fn(compiles), limit_bytes=1_000,
+    )
+    # walked 1 -> 2, then no legal split remains (4 would leave micro 4 on
+    # the 8-wide data axis); still over limit but proceeds
+    assert trainer.batch_split == 2 and compiles == [1, 2]
+    assert report["applied"] is True and report["bytes"] == 5_000
+
+
+def test_hbm_preflight_disabled_or_no_limit(tmp_path):
+    """hbm_preflight=False (or a backend with no memory limit, e.g. CPU)
+    must be a clean no-op."""
+    trainer, _ = _make_trainer(tmp_path, batch_split=1, hbm_preflight=False)
+    assert trainer._preflight_done  # the train loop will not re-plan
+    assert trainer.preflight_train_step(None, None) is None
+    assert trainer.batch_split == 1
+
+    trainer2, _ = _make_trainer(tmp_path, batch_split=1)
+    assert not trainer2._preflight_done
+    # CPU devices report no bytes_limit -> planner stands down
+    assert trainer2.preflight_train_step(None, None) is None
+    assert trainer2.batch_split == 1 and trainer2._preflight_done
