@@ -351,6 +351,144 @@ def bench_infer(args) -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_serve(args) -> None:
+    """Closed-loop latency benchmark of the online serving subsystem
+    (``ml_recipe_tpu/serve/``): N client threads drive the QAEngine with
+    synthetic question/document requests (``data/synthetic.py`` generator),
+    each issuing its next request when the previous one answers. Emits
+    p50/p95/p99 latency, throughput, and batch-occupancy in the JSON line —
+    the serving counterparts of the train/infer headline numbers."""
+    import dataclasses
+    import shutil
+    import tempfile
+    import threading
+    from pathlib import Path
+
+    import jax
+    import jax.numpy as jnp
+
+    from ml_recipe_tpu.data.synthetic import (
+        make_learnable_line,
+        write_learnable_vocab,
+    )
+    from ml_recipe_tpu.models import MODEL_PRESETS, QAModel
+    from ml_recipe_tpu.parallel import build_mesh
+    from ml_recipe_tpu.serve.bucketing import BucketGrid
+    from ml_recipe_tpu.serve.engine import QAEngine
+    from ml_recipe_tpu.tokenizer import Tokenizer
+
+    n_chips = len(jax.devices())
+    mesh = build_mesh()
+    grid = BucketGrid.from_spec(args.serve_buckets)
+
+    tmp = Path(tempfile.mkdtemp(prefix="bench_serve_"))
+    try:
+        tokenizer = Tokenizer(
+            "bert", str(write_learnable_vocab(tmp)), lowercase=True
+        )
+        cfg = MODEL_PRESETS[args.model]
+        # the synthetic corpus has a tiny closed vocab; positions must cover
+        # the largest bucket
+        cfg = dataclasses.replace(cfg, vocab_size=max(len(tokenizer), 128))
+        cfg = _widen_positions(cfg, grid.max_seq)
+        model = QAModel(cfg, dtype=jnp.bfloat16, attention_impl="auto",
+                        ln_impl=args.ln_impl)
+        params = model.init(
+            jax.random.key(0), np.zeros((1, 8), dtype=np.int32)
+        )["params"]
+
+        engine = QAEngine(
+            model, params, tokenizer, grid=grid, mesh=mesh,
+            max_batch_delay_ms=args.max_batch_delay_ms,
+            queue_size=args.serve_queue_size,
+            max_question_len=16, doc_stride=args.doc_stride,
+        )
+        warm = engine.warmup(hbm_preflight=args.hbm_preflight)
+
+        rng = np.random.default_rng(0)
+        requests = [
+            make_learnable_line(i, rng) for i in range(args.serve_requests)
+        ]
+
+        lock = threading.Lock()
+        next_i = [0]
+        latencies: list = []
+        rejected = [0]
+        failed = [0]
+
+        def client() -> None:
+            while True:
+                with lock:
+                    if next_i[0] >= len(requests):
+                        return
+                    line = requests[next_i[0]]
+                    next_i[0] += 1
+                t_req = time.perf_counter()
+                try:
+                    ticket = engine.submit(
+                        line["question_text"], line["document_text"]
+                    )
+                    ticket.result(timeout=120)
+                except Exception as e:  # noqa: BLE001 - count, keep looping
+                    with lock:
+                        if "queue full" in str(e).lower():
+                            rejected[0] += 1
+                        else:
+                            failed[0] += 1
+                    continue
+                dt = time.perf_counter() - t_req
+                with lock:
+                    latencies.append(dt)
+
+        threads = [
+            threading.Thread(target=client, name=f"serve-client-{i}")
+            for i in range(args.serve_clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        engine.close()
+
+        lat_ms = np.sort(np.asarray(latencies)) * 1e3
+        pct = lambda q: (  # noqa: E731 - one-shot percentile accessor
+            round(float(np.percentile(lat_ms, q)), 2) if lat_ms.size else None
+        )
+        occ = engine.m_occupancy.mean
+        waste = engine.m_padding_waste.mean
+        print(
+            json.dumps(
+                {
+                    "metric": f"{args.model}_qa_serve_p95_ms",
+                    "value": pct(95),
+                    "unit": "ms",
+                    "p50_ms": pct(50),
+                    "p95_ms": pct(95),
+                    "p99_ms": pct(99),
+                    "throughput_rps": round(len(latencies) / elapsed, 2)
+                    if elapsed > 0 else None,
+                    "requests": len(latencies),
+                    "rejected_queue_full": rejected[0],
+                    "failed": failed[0],
+                    "clients": args.serve_clients,
+                    "batches": int(engine.m_batches.value),
+                    "batch_occupancy_mean": round(occ, 4) if occ else None,
+                    "padding_waste_mean": round(waste, 4) if waste else None,
+                    "buckets": [str(b) for b in grid],
+                    "max_batch_delay_ms": args.max_batch_delay_ms,
+                    "warmup_seconds": warm["warmup_seconds"],
+                    "autotune_probes": warm["autotune"]["probes"],
+                    "n_chips": n_chips,
+                    "backend": jax.default_backend(),
+                }
+            )
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_converge(args) -> None:
     """Train on-chip on the synthetic LEARNABLE corpus and emit the loss
     curve + final eval metrics (VERDICT r2 #1b: proof the framework learns,
@@ -458,7 +596,8 @@ def bench_converge(args) -> None:
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--mode", choices=("train", "infer", "converge"), default="train")
+    parser.add_argument("--mode", choices=("train", "infer", "converge", "serve"),
+                        default="train")
     parser.add_argument("--seq_len", type=int, default=512)
     parser.add_argument("--global_batch", type=int, default=256)
     # micro-batch 64 (split 4) is the measured single-v5e sweet spot with the
@@ -513,6 +652,15 @@ def main() -> None:
     parser.add_argument("--converge_lr", type=float, default=1e-4)
     parser.add_argument("--converge_warmup", type=float, default=0.2)
     parser.add_argument("--converge_examples", type=int, default=2048)
+    # --mode serve knobs (closed loop: each client issues its next request
+    # when the previous one answers; occupancy comes from concurrency)
+    parser.add_argument("--serve_buckets", type=str, default="8x128,32x128",
+                        help="serve mode: bucket grid 'BATCHxSEQ,...'")
+    parser.add_argument("--serve_clients", type=int, default=8)
+    parser.add_argument("--serve_requests", type=int, default=128,
+                        help="serve mode: total requests across clients")
+    parser.add_argument("--serve_queue_size", type=int, default=256)
+    parser.add_argument("--max_batch_delay_ms", type=float, default=10.0)
     # geometry autotuner + HBM pre-flight (mirrors config/parser.py)
     parser.add_argument("--autotune", type=_str2bool, default=True,
                         help="Compile-probe kernel geometry autotuner; off "
@@ -538,6 +686,8 @@ def main() -> None:
         return bench_infer(args)
     if args.mode == "converge":
         return bench_converge(args)
+    if args.mode == "serve":
+        return bench_serve(args)
 
     import jax
     import jax.numpy as jnp

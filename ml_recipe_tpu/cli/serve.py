@@ -1,0 +1,101 @@
+"""Online QA serving entry point.
+
+Boots the serving subsystem (``ml_recipe_tpu/serve/``): load model +
+checkpoint, build the bucket grid, warm every bucket program through the
+autotune cache (a warm restart performs zero probes), pre-flight each
+bucket against device HBM (shrinking the grid instead of OOMing
+mid-traffic), then serve ``POST /v1/qa`` until SIGTERM drains it.
+
+Usage::
+
+    python -m ml_recipe_tpu.cli.serve -c config/serve.cfg
+
+No reference counterpart: the reference stack (and this repo's
+``cli/validate.py``) is an offline batch predictor; this is the long-running
+request/response engine the ROADMAP's "serves heavy traffic" north star
+needs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+from ..compose import init_model
+from ..config.parser import get_model_parser, get_params, get_serve_parser
+from ..ops import autotune
+from ..parallel import build_mesh
+from ..utils.logging import get_logger, show_params
+
+
+def main(params, model_params) -> int:
+    from ..serve.bucketing import BucketGrid
+    from ..serve.engine import QAEngine
+    from ..serve.server import QAServer
+
+    show_params(model_params, "model")
+    show_params(params, "serve")
+
+    autotune.configure(
+        enabled=params.autotune, cache_dir=params.autotune_cache
+    )
+
+    model, model_state, tokenizer = init_model(
+        model_params, checkpoint=params.checkpoint
+    )
+    mesh = build_mesh(getattr(params, "mesh", None))
+
+    engine = QAEngine(
+        model,
+        model_state,
+        tokenizer,
+        grid=BucketGrid.from_spec(params.buckets),
+        mesh=mesh,
+        max_batch_delay_ms=params.max_batch_delay_ms,
+        queue_size=params.queue_size,
+        max_question_len=params.max_question_len,
+        doc_stride=params.doc_stride,
+    )
+    engine.warmup(hbm_preflight=params.hbm_preflight)
+
+    server = QAServer(
+        engine,
+        host=params.host,
+        port=params.port,
+        request_timeout_s=params.request_timeout_s,
+        drain_timeout_s=params.drain_timeout_s,
+    )
+    server.install_signal_handlers()
+    server.start()
+
+    if params.ready_file:
+        # orchestration hook (supervisor, chaos drills): the listener is up
+        # and every bucket is compiled — traffic is safe to send
+        ready = Path(params.ready_file)
+        tmp = ready.with_name(ready.name + ".tmp")
+        tmp.write_text(json.dumps({
+            "host": server.host, "port": server.port, "pid": os.getpid(),
+            "buckets": [str(b) for b in engine.grid],
+        }))
+        os.replace(tmp, ready)
+
+    try:
+        server.wait()
+    finally:
+        server.shutdown()
+    return 0
+
+
+def cli() -> None:
+    from ..utils.platform import honor_env_platform
+
+    honor_env_platform()
+    _, (params, model_params) = get_params((get_serve_parser, get_model_parser))
+    get_logger(logger_name="serve")
+
+    raise SystemExit(main(params, model_params))
+
+
+if __name__ == "__main__":
+    cli()

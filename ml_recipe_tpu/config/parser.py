@@ -552,6 +552,78 @@ def get_predictor_parser() -> ConfigArgumentParser:
     return parser
 
 
+def get_serve_parser() -> ConfigArgumentParser:
+    """Online-serving config ([serve] surface): bucket grid, micro-batch
+    deadline, bounded-queue backpressure, HTTP bind, drain budget. No
+    reference counterpart — the reference stack is offline-only."""
+    parser = ConfigArgumentParser(description="Serve config parser.", add_help=False)
+
+    parser.add_argument("-c", "--config_file", required=False, is_config_file=True,
+                        help="Config file path.")
+    parser.add_argument("--serve_config_file", required=False, is_config_file=True,
+                        help="Serve config file path.")
+
+    parser.add_argument("--checkpoint", type=cast2(str), default=None,
+                        help="Restored checkpoint path (None = random init — "
+                             "smoke/bench only).")
+
+    parser.add_argument("--host", type=str, default="127.0.0.1",
+                        help="HTTP bind address.")
+    parser.add_argument("--port", type=int, default=8080,
+                        help="HTTP bind port (0 = ephemeral).")
+
+    parser.add_argument("--buckets", type=str, default="8x128,8x384,32x384",
+                        help="Serving bucket grid 'BATCHxSEQ,...': the fixed "
+                             "set of pre-compiled (batch, seq) programs. A "
+                             "chunk runs in the smallest seq bucket that "
+                             "fits it; concurrent chunks coalesce up to the "
+                             "bucket batch.")
+    parser.add_argument("--max_batch_delay_ms", type=float, default=10.0,
+                        help="Micro-batch deadline: a queued chunk waits at "
+                             "most this long for co-riders before its "
+                             "bucket launches (a full bucket launches "
+                             "immediately).")
+    parser.add_argument("--queue_size", type=int, default=256,
+                        help="Bounded work-queue size in CHUNKS; admission "
+                             "past it is rejected with 429 (backpressure) "
+                             "instead of growing unboundedly.")
+    parser.add_argument("--request_timeout_s", type=float, default=60.0,
+                        help="Per-request completion deadline (504 past it).")
+    parser.add_argument("--drain_timeout_s", type=float, default=30.0,
+                        help="SIGTERM drain budget: flush admitted work and "
+                             "close within this long.")
+
+    parser.add_argument("--max_question_len", type=int, default=64,
+                        help="Max question length in tokens.")
+    parser.add_argument("--doc_stride", type=int, default=128,
+                        help="Sliding-window stride for request chunking.")
+
+    parser.add_argument("--mesh", type=cast2(str), default=None,
+                        help="Device mesh axes, e.g. 'data:8'. None = all "
+                             "devices on the data axis.")
+
+    parser.add_argument("--autotune", type=_str2bool, default=True,
+                        help="Kernel-geometry autotuner during bucket "
+                             "warmup compiles (ops/autotune.py); the "
+                             "on-disk tuning cache makes a warm restart "
+                             "zero-probe.")
+    parser.add_argument("--autotune_cache", type=cast2(str), default=None,
+                        help="Tuning-cache directory (default "
+                             "artifacts/tuning/, or $MLRT_AUTOTUNE_CACHE).")
+    parser.add_argument("--hbm_preflight", type=_str2bool, default=True,
+                        help="Per-bucket predict-step HBM pre-flight at "
+                             "warmup: memory_analysis each bucket program "
+                             "and DROP buckets that exceed device HBM "
+                             "instead of OOMing mid-traffic.")
+
+    parser.add_argument("--ready_file", type=cast2(str), default=None,
+                        help="Write {host, port, pid} JSON here once the "
+                             "listener is up (supervisor / test "
+                             "orchestration hook).")
+
+    return parser
+
+
 def resolve_precision(params) -> str:
     """Map (precision, apex_level) onto the native policy: 'bf16' or 'f32'."""
     if getattr(params, "precision", None):

@@ -35,7 +35,9 @@ import numpy as np
 from ..data import RawPreprocessor
 from ..data.loader import ListDataloader
 from ..parallel import build_mesh, gather_to_host, make_global_array
+from ..serve.bucketing import pad_trailing_batch
 from ..utils.pipeline import LaggedConsumer
+from .score import OUT_KEYS, build_score_fn
 
 logger = logging.getLogger(__name__)
 
@@ -175,74 +177,21 @@ class Predictor:
 
     # -- compiled forward ------------------------------------------------------
 
-    _OUT_KEYS = ("scores", "start_ids", "end_ids", "start_regs", "end_regs",
-                 "labels")
+    # row order of the packed [6, B] output (kept as a class attribute for
+    # back-compat; the canonical tuple lives in infer/score.py, shared with
+    # the serving engine)
+    _OUT_KEYS = OUT_KEYS
 
     def _build_fwd(self):
-        model = self.model
-        ids_only = self._wire_ids_only
-        if ids_only:
-            pad_id, sep_id, is_bert = self._pad_id, self._sep_id, self._is_bert
-
-        def fwd(params, packed_inputs):
-            import jax.numpy as jnp
-
-            if ids_only:
-                # uint16 [B, L] ids; mask and token types derived in-jit
-                # (see __init__ — collate.py:42-53 semantics reproduced)
-                ids = packed_inputs.astype(jnp.int32)
-                mask = (ids != pad_id).astype(jnp.int32)
-                if is_bert:
-                    seps = (ids == sep_id).astype(jnp.int32)
-                    tt = jnp.clip(jnp.cumsum(seps, axis=-1) - seps, 0, 1)
-                else:
-                    tt = jnp.zeros_like(ids)
-                inputs = {
-                    "input_ids": ids,
-                    "attention_mask": mask,
-                    "token_type_ids": tt,
-                }
-            else:
-                # packed [3, B, L] int32: one transfer instead of three
-                inputs = {
-                    "input_ids": packed_inputs[0],
-                    "attention_mask": packed_inputs[1],
-                    "token_type_ids": packed_inputs[2],
-                }
-            preds = model.apply({"params": params}, **inputs, deterministic=True)
-
-            start = preds["start_class"]  # [B, L], pad positions already -inf
-            end = preds["end_class"]
-
-            start_logits = jnp.max(start, axis=-1)
-            start_ids = jnp.argmax(start, axis=-1)
-            end_logits = jnp.max(end, axis=-1)
-            end_ids = jnp.argmax(end, axis=-1)
-
-            cls_probas = jax.nn.softmax(preds["cls"], axis=-1)
-            cls_ids = jnp.argmax(cls_probas, axis=-1)
-
-            # answerability score, arXiv 1901.08634 (predictor.py:119-120)
-            scores = start_logits + end_logits - (start[:, 0] + end[:, 0])
-
-            # ONE packed [6, B] f32 output: the per-batch host gather is a
-            # single fetch instead of six (device->host round-trips dominate
-            # the loop once the forward is fused; ids/labels are exact in
-            # f32 — L and the 5-class space are far below 2^24). Row order
-            # comes from _OUT_KEYS, the same tuple consume() decodes by.
-            fields = {
-                "scores": scores,
-                "start_ids": start_ids,
-                "end_ids": end_ids,
-                "start_regs": preds["start_reg"],
-                "end_regs": preds["end_reg"],
-                "labels": cls_ids,
-            }
-            return jnp.stack(
-                [fields[k].astype(jnp.float32) for k in Predictor._OUT_KEYS],
-                axis=0,
+        # the scoring forward is shared with serve/engine.py (one packed
+        # [6, B] fetch per batch; see infer/score.py for the wire formats)
+        if self._wire_ids_only:
+            fwd = build_score_fn(
+                self.model, wire_ids_only=True, pad_id=self._pad_id,
+                sep_id=self._sep_id, is_bert=self._is_bert,
             )
-
+        else:
+            fwd = build_score_fn(self.model, wire_ids_only=False)
         return jax.jit(fwd)
 
     # -- candidate tracking (predictor.py:63-87) -------------------------------
@@ -369,13 +318,8 @@ class Predictor:
                     n_valid = len(items)
                     if n_valid < self.batch_size:
                         # pad the trailing partial batch to the static shape
-                        pad = self.batch_size - n_valid
-                        inputs = {
-                            k: np.concatenate(
-                                [v, np.repeat(v[-1:], pad, axis=0)]
-                            )
-                            for k, v in inputs.items()
-                        }
+                        # (shared helper — serving pads rows the same way)
+                        inputs = pad_trailing_batch(inputs, self.batch_size)
                     if self._wire_ids_only:
                         packed = np.asarray(
                             inputs["input_ids"], np.uint16

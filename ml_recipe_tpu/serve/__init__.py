@@ -1,0 +1,48 @@
+"""Online serving subsystem: continuous-batching QA inference over a fixed
+grid of pre-compiled ``(batch, seq)`` bucket programs.
+
+Layers (each importable on its own):
+
+- :mod:`.bucketing` — the bucket grid + pad-to-bucket admission (also home
+  to ``pad_trailing_batch``, shared with ``infer/predictor.py``);
+- :mod:`.batcher` — deadline-coalescing micro-batch queue with bounded-queue
+  backpressure;
+- :mod:`.engine` — request chunking, scatter into shared batches, and
+  per-request span reduction through the same jitted score function as the
+  batch predictor (``infer/score.py``);
+- :mod:`.metrics` — first-party Prometheus-text Counter/Gauge/Histogram;
+- :mod:`.server` — stdlib HTTP front end (``POST /v1/qa``, ``/healthz``,
+  ``/metrics``) with SIGTERM drain.
+
+``engine``/``server`` are imported lazily: ``infer/predictor.py`` imports
+``serve.bucketing`` for the shared pad helper, and an eager engine import
+here would create an import cycle back through ``infer``.
+"""
+
+from __future__ import annotations
+
+from .batcher import ChunkWork, DrainingError, MicroBatcher, QueueFullError
+from .bucketing import Bucket, BucketGrid, parse_bucket_spec, pad_trailing_batch
+from .metrics import Counter, Gauge, Histogram, Registry
+
+__all__ = [
+    "Bucket", "BucketGrid", "parse_bucket_spec", "pad_trailing_batch",
+    "ChunkWork", "DrainingError", "MicroBatcher", "QueueFullError",
+    "Counter", "Gauge", "Histogram", "Registry",
+    "QAEngine", "QAResult", "RequestTicket", "RequestRejected",
+    "QAServer",
+]
+
+_LAZY = {
+    "QAEngine": "engine", "QAResult": "engine", "RequestTicket": "engine",
+    "RequestRejected": "engine", "QAServer": "server",
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        module = importlib.import_module(f".{_LAZY[name]}", __name__)
+        return getattr(module, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,588 @@
+"""Online QA inference engine: request -> chunks -> shared batches -> span.
+
+Turns the offline packed-forward predictor into a long-running
+request/response engine:
+
+- each request's document is sliding-window chunked host-side (the same
+  ``data/chunking.py`` machinery the datasets use — chunk geometry is
+  data-dependent and stays outside jit);
+- chunks are scattered into the continuous micro-batcher
+  (``batcher.MicroBatcher``), which coalesces concurrent requests into
+  ``(batch, seq)`` buckets from the fixed grid (``bucketing.BucketGrid``) —
+  the whole traffic distribution is served by N long-lived compiled
+  programs, warmed at startup;
+- every batch runs the SAME jitted scoring forward as the batch predictor
+  (``infer/score.py``: model forward + arXiv 1901.08634 answerability score,
+  ONE packed [6, B] f32 fetch per batch), so serving spans match
+  ``infer/predictor.py`` for the same inputs by construction;
+- when a request's last chunk lands, chunks are reduced IN CHUNK ORDER with
+  the predictor's exact validity rules (span order, answer not inside the
+  question, best-score-wins with predictor tie semantics), and the winning
+  span is decoded back to text.
+
+HBM pre-flight (``preflight_predict_step``): at warmup each bucket's program
+is lowered + compiled once and XLA's ``memory_analysis()`` is read; a bucket
+whose projected requirement exceeds device HBM is DROPPED FROM THE GRID
+(logged) instead of OOMing mid-traffic — the ROADMAP's "extend the
+pre-flight to eval/predict steps" item, sharing the byte arithmetic with
+``Trainer.preflight_train_step``.
+
+Everything here runs under ``JAX_PLATFORMS=cpu`` for tier-1: buckets compile
+on CPU and the request path has no TPU-only branches.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..data import RawPreprocessor
+from ..data.chunking import (
+    assemble_input_ids,
+    encode_document,
+    window_chunks,
+)
+from ..infer.score import OUT_KEYS, build_score_fn
+from ..ops import autotune
+from ..parallel import build_mesh, make_global_array
+# the HBM byte arithmetic is shared with Trainer.preflight_train_step — one
+# definition of "projected per-device bytes" for train and predict steps
+# (utils/hbm.py: the serving path must not import the training stack)
+from ..utils.hbm import device_hbm_bytes, preflight_bytes
+from .batcher import ChunkWork, DrainingError, MicroBatcher, QueueFullError
+from .bucketing import Bucket, BucketGrid, pad_trailing_batch
+from .metrics import Registry
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "QAEngine", "QAResult", "RequestTicket", "RequestRejected",
+    "QueueFullError", "DrainingError",
+]
+
+
+class RequestRejected(ValueError):
+    """The request cannot be admitted at all (over-long question, empty
+    document) — a client error, not backpressure."""
+
+
+@dataclass
+class QAResult:
+    """Final per-request answer."""
+
+    answer: str
+    label: str           # 'yes' | 'no' | 'short' | 'long' | 'unknown'
+    score: float         # answerability score of the winning chunk (0 if none)
+    start: int           # winning span in final-input token coordinates
+    end: int
+    n_chunks: int
+    latency_ms: float
+
+    def to_json(self) -> dict:
+        return {
+            "answer": self.answer,
+            "label": self.label,
+            "score": round(float(self.score), 6),
+            "start": int(self.start),
+            "end": int(self.end),
+            "n_chunks": int(self.n_chunks),
+            "latency_ms": round(float(self.latency_ms), 3),
+        }
+
+
+@dataclass
+class _ChunkRef:
+    """Batcher payload: which request, which chunk."""
+
+    ticket: "RequestTicket"
+    idx: int
+    input_ids: List[int]
+
+
+class RequestTicket:
+    """Completion handle for one submitted request."""
+
+    def __init__(self, *, n_chunks: int, question_len: int):
+        self.n_chunks = n_chunks
+        self.question_len = question_len
+        self.created_at = time.perf_counter()
+        self.chunks: List[List[int]] = []
+        self._outputs: Dict[int, Tuple] = {}
+        self._event = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._result: Optional[QAResult] = None
+        self._lock = threading.Lock()
+
+    def _offer(self, idx: int, row: Dict[str, float]) -> bool:
+        """Record one chunk's packed-output row; True when this was the
+        last outstanding chunk."""
+        with self._lock:
+            self._outputs[idx] = row
+            return len(self._outputs) == self.n_chunks
+
+    def _fail(self, exc: BaseException) -> None:
+        with self._lock:
+            if self._event.is_set():
+                return
+            self._error = exc
+        self._event.set()
+
+    def _finish(self, result: QAResult) -> None:
+        self._result = result
+        self._event.set()
+
+    def result(self, timeout: Optional[float] = None) -> QAResult:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request did not complete within {timeout}s "
+                f"({len(self._outputs)}/{self.n_chunks} chunks done)"
+            )
+        if self._error is not None:
+            raise self._error
+        assert self._result is not None
+        return self._result
+
+
+class QAEngine:
+    """Long-running QA serving engine over one model + parameter set."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        tokenizer,
+        *,
+        grid: BucketGrid,
+        mesh=None,
+        max_batch_delay_ms: float = 10.0,
+        queue_size: int = 256,
+        max_question_len: int = 64,
+        doc_stride: int = 128,
+        registry: Optional[Registry] = None,
+    ):
+        self.model = model
+        self.params = params
+        self.tokenizer = tokenizer
+        self.grid = grid
+        self.mesh = mesh if mesh is not None else build_mesh()
+        self.max_question_len = int(max_question_len)
+        self.doc_stride = int(doc_stride)
+        self._closed = False
+
+        # ids-only wire when the vocab fits uint16 (predictor parity — see
+        # infer/score.py for the two wire formats)
+        try:
+            vocab = len(tokenizer)
+        except TypeError:
+            vocab = getattr(tokenizer, "vocab_size", 1 << 20)
+        self._pad_id = int(tokenizer.pad_token_id)
+        self._sep_id = int(tokenizer.sep_token_id)
+        self._cls_id = int(tokenizer.cls_token_id)
+        self._is_bert = getattr(tokenizer, "model_name", "bert") == "bert"
+        self._wire_ids_only = vocab is not None and vocab < 2 ** 16
+        if self._wire_ids_only:
+            fwd = build_score_fn(
+                model, wire_ids_only=True, pad_id=self._pad_id,
+                sep_id=self._sep_id, is_bert=self._is_bert,
+            )
+        else:
+            fwd = build_score_fn(model, wire_ids_only=False)
+        import jax
+
+        self._jit = jax.jit(fwd)
+
+        # -- metrics plane ---------------------------------------------------
+        self.metrics = registry if registry is not None else Registry()
+        m = self.metrics
+        self.m_requests = m.counter(
+            "qa_requests_total", "QA requests admitted.")
+        self.m_completed = m.counter(
+            "qa_requests_completed_total", "QA requests answered.")
+        self.m_failed = m.counter(
+            "qa_requests_failed_total", "QA requests failed internally.")
+        self.m_rejected_full = m.counter(
+            "qa_rejected_queue_full_total",
+            "Requests rejected by queue-full backpressure.")
+        self.m_rejected_draining = m.counter(
+            "qa_rejected_draining_total",
+            "Requests rejected while draining for shutdown.")
+        self.m_rejected_invalid = m.counter(
+            "qa_rejected_invalid_total",
+            "Requests rejected as unservable (over-long, empty).")
+        self.m_queue_depth = m.gauge(
+            "qa_queue_depth", "Chunks waiting in the micro-batch queue.")
+        self.m_batches = m.counter(
+            "qa_batches_total", "Bucket batches launched.")
+        self.m_last_batch_rows = m.gauge(
+            "qa_last_batch_rows", "Valid rows in the most recent batch.")
+        self.m_occupancy = m.histogram(
+            "qa_batch_occupancy",
+            "Valid rows / bucket batch rows per launched batch.",
+            buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
+        )
+        self.m_padding_waste = m.histogram(
+            "qa_padding_waste_ratio",
+            "Padded token slots / total token slots per launched batch.",
+            buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
+        )
+        self.m_latency = m.histogram(
+            "qa_request_latency_seconds",
+            "End-to-end request latency (submit to reduced answer).")
+        self.m_latency_p50 = m.gauge(
+            "qa_request_latency_p50_seconds",
+            "p50 request latency over recent requests.")
+        self.m_latency_p95 = m.gauge(
+            "qa_request_latency_p95_seconds",
+            "p95 request latency over recent requests.")
+        self.m_latency_p99 = m.gauge(
+            "qa_request_latency_p99_seconds",
+            "p99 request latency over recent requests.")
+
+        self.batcher = MicroBatcher(
+            grid,
+            self._run_batch,
+            max_batch_delay_ms=max_batch_delay_ms,
+            queue_size=queue_size,
+            fail_fn=self._fail_batch,
+            on_depth=self.m_queue_depth.set,
+        )
+        self.warmup_report: Optional[dict] = None
+
+    # -- warmup + predict-step HBM pre-flight ---------------------------------
+
+    def _dummy_inputs(self, bucket: Bucket) -> dict:
+        """A dense (fully-attended) host batch at the bucket shape:
+        [CLS] filler... [SEP] rows, so warmup executes the same program
+        shape traffic will."""
+        ids = np.full((bucket.batch, bucket.seq), self._cls_id, np.int32)
+        ids[:, -1] = self._sep_id
+        lengths = np.full((bucket.batch,), bucket.seq, np.int32)
+        return self._host_arrays(ids, lengths)
+
+    def _host_arrays(self, ids: np.ndarray, lengths: np.ndarray) -> dict:
+        """collate-shaped host dict from an id plane + true row lengths."""
+        positions = np.arange(ids.shape[1], dtype=np.int32)[None, :]
+        attention_mask = (positions < lengths[:, None]).astype(np.int32)
+        token_type_ids = np.zeros_like(ids)
+        if self._is_bert:
+            for i in range(ids.shape[0]):
+                row = ids[i, : lengths[i]]
+                seps = np.flatnonzero(row == self._sep_id)
+                sep_pos = int(seps[0]) if seps.size else int(lengths[i]) - 1
+                token_type_ids[i, sep_pos + 1: lengths[i]] = 1
+        return {
+            "input_ids": ids,
+            "attention_mask": attention_mask,
+            "token_type_ids": token_type_ids,
+        }
+
+    def _wire_pack(self, inputs: dict):
+        """Host dict -> device array in the engine's wire format.
+
+        Bucket batches divisible by the mesh data axis are sharded over it
+        (predictor parity); smaller buckets are REPLICATED instead — a
+        2-row bucket on an 8-device mesh is a legitimate low-latency
+        configuration, and refusing it would force every grid to scale with
+        the pod. Warmup logs which placement each bucket got."""
+        if self._wire_ids_only:
+            packed = np.asarray(inputs["input_ids"], np.uint16)
+            batch_axis = 0
+        else:
+            packed = np.stack(
+                [
+                    np.asarray(inputs["input_ids"], np.int32),
+                    np.asarray(inputs["attention_mask"], np.int32),
+                    np.asarray(inputs["token_type_ids"], np.int32),
+                ]
+            )
+            batch_axis = 1
+        data_size = int(dict(zip(self.mesh.axis_names,
+                                 self.mesh.devices.shape)).get("data", 1))
+        if packed.shape[batch_axis] % max(data_size, 1) == 0:
+            if batch_axis == 0:
+                return make_global_array(packed, self.mesh)
+            return make_global_array(packed, self.mesh, batch_axis=1)
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(
+            packed, NamedSharding(self.mesh, PartitionSpec())
+        )
+
+    def preflight_predict_step(
+        self, bucket: Bucket, *, limit_bytes=None, compile_fn=None,
+    ) -> Optional[dict]:
+        """Lower + compile one bucket's program and read XLA's
+        ``memory_analysis()``; returns ``{"bytes": projected, "limit":
+        device_hbm, "fits": bool}`` or None when no limit/analysis is
+        available (the planner stands down rather than guessing — CPU tier-1
+        exercises the decision through ``compile_fn``/``limit_bytes``
+        injection, exactly like ``Trainer.preflight_train_step``)."""
+        limit = limit_bytes if limit_bytes is not None else device_hbm_bytes()
+        if limit is None:
+            return None
+        if compile_fn is not None:
+            compiled = compile_fn(bucket)
+        else:
+            with self.mesh:
+                dev = self._wire_pack(self._dummy_inputs(bucket))
+                compiled = self._jit.lower(self.params, dev).compile()
+        try:
+            analysis = compiled.memory_analysis()
+        except Exception as e:  # noqa: BLE001 - analysis is best-effort
+            logger.info("predict pre-flight: memory_analysis unavailable "
+                        "(%s); skipping.", e)
+            return None
+        need = preflight_bytes(analysis)
+        if need is None:
+            return None
+        return {"bytes": int(need), "limit": int(limit),
+                "fits": need <= limit}
+
+    def warmup(self, *, hbm_preflight: bool = True, limit_bytes=None,
+               compile_fn=None) -> dict:
+        """Compile every bucket program up front (startup pays all compiles;
+        traffic pays none) and pre-flight each against device HBM, shrinking
+        the grid instead of OOMing mid-traffic. Kernel-geometry decisions
+        ride the process-wide autotune cache, so a warm restart performs
+        zero probes (the report carries the autotuner's session summary)."""
+        t0 = time.perf_counter()
+        report = {
+            "buckets": [], "dropped": [], "preflight": {},
+            "wire": "ids" if self._wire_ids_only else "3plane",
+        }
+        for bucket in list(self.grid):
+            if hbm_preflight:
+                verdict = self.preflight_predict_step(
+                    bucket, limit_bytes=limit_bytes, compile_fn=compile_fn,
+                )
+                if verdict is not None:
+                    report["preflight"][str(bucket)] = verdict
+                    if not verdict["fits"]:
+                        if self.grid.drop(bucket):
+                            logger.warning(
+                                "predict pre-flight: bucket %s needs %.2f GB "
+                                "vs %.2f GB device HBM; dropping it from the "
+                                "serving grid.", bucket,
+                                verdict["bytes"] / 1e9, verdict["limit"] / 1e9,
+                            )
+                            report["dropped"].append(str(bucket))
+                            continue
+                        logger.warning(
+                            "predict pre-flight: bucket %s exceeds device "
+                            "HBM but is the last bucket; keeping it — XLA "
+                            "will decide.", bucket,
+                        )
+            # execute once at the bucket shape so the dispatch-path cache is
+            # hot before traffic arrives
+            with self.mesh:
+                dev = self._wire_pack(self._dummy_inputs(bucket))
+                np.asarray(self._jit(self.params, dev))
+            report["buckets"].append(str(bucket))
+        report["autotune"] = autotune.get().session_summary()
+        report["warmup_seconds"] = round(time.perf_counter() - t0, 3)
+        self.warmup_report = report
+        self.batcher.start()
+        logger.info(
+            "serving warmup: %d bucket programs compiled (%s dropped by "
+            "pre-flight) in %.1fs; autotune probes this session: %d.",
+            len(report["buckets"]), len(report["dropped"]) or "none",
+            report["warmup_seconds"], report["autotune"]["probes"],
+        )
+        return report
+
+    # -- request admission -----------------------------------------------------
+
+    def submit(self, question: str, document: str) -> RequestTicket:
+        """Chunk + admit one request; returns a completion ticket.
+
+        Raises :class:`RequestRejected` (client error),
+        :class:`QueueFullError` (backpressure) or :class:`DrainingError`
+        (shutting down)."""
+        if self._closed:
+            self.m_rejected_draining.inc()
+            raise DrainingError("engine is shut down")
+        if not question or not document:
+            self.m_rejected_invalid.inc()
+            raise RequestRejected("question and document must be non-empty")
+
+        max_seq = self.grid.max_seq
+        enc_q = self.tokenizer.encode(question)[: self.max_question_len]
+        if len(enc_q) + 3 >= max_seq:
+            self.m_rejected_invalid.inc()
+            raise RequestRejected(
+                f"question tokenizes to {len(enc_q)} tokens; the largest "
+                f"serving bucket ({max_seq}) leaves no room for a document"
+            )
+        tokens, _, _ = encode_document(self.tokenizer, document)
+        # spanless target: serving has no gold answer; the chunker only
+        # needs geometry
+        records = window_chunks(
+            tokens, ("unknown", -1, -1),
+            question_len=len(enc_q), max_seq_len=max_seq,
+            doc_stride=self.doc_stride,
+        )
+        if len(records) > self.batcher.queue_size:
+            # more chunks than the queue can EVER hold: admission would
+            # reject this request on an idle server too, so 429-and-retry
+            # would loop forever — fail it as a client error up front,
+            # before paying per-chunk assembly
+            self.m_rejected_invalid.inc()
+            raise RequestRejected(
+                f"document chunks into {len(records)} windows, beyond the "
+                f"work queue's total capacity ({self.batcher.queue_size}); "
+                f"split the document or raise queue_size"
+            )
+
+        ticket = RequestTicket(
+            n_chunks=len(records), question_len=len(enc_q))
+        works: List[ChunkWork] = []
+        for idx, rec in enumerate(records):
+            input_ids = assemble_input_ids(
+                self._cls_id, self._sep_id, enc_q, rec)
+            seq = self.grid.admit(len(input_ids))
+            if seq is None:  # unreachable with window_chunks at max_seq,
+                # kept as a hard error: an unadmittable chunk must never
+                # reach the compile path
+                self.m_rejected_invalid.inc()
+                raise RequestRejected(
+                    f"chunk of {len(input_ids)} tokens exceeds every "
+                    f"serving bucket (max {max_seq})"
+                )
+            ticket.chunks.append(input_ids)
+            works.append(ChunkWork(
+                seq=seq, payload=_ChunkRef(ticket, idx, input_ids)))
+
+        try:
+            self.batcher.submit_many(works)
+        except QueueFullError:
+            self.m_rejected_full.inc()
+            raise
+        except DrainingError:
+            self.m_rejected_draining.inc()
+            raise
+        self.m_requests.inc()
+        return ticket
+
+    # -- batch execution (batcher thread) --------------------------------------
+
+    def _run_batch(self, seq: int, works: Sequence[ChunkWork]) -> None:
+        n = len(works)
+        batch = self.grid.batch_for(seq, n)
+
+        ids = np.full((n, seq), self._pad_id, np.int32)
+        lengths = np.empty((n,), np.int32)
+        for i, w in enumerate(works):
+            row = w.payload.input_ids
+            ids[i, : len(row)] = row
+            lengths[i] = len(row)
+        if self._wire_ids_only:
+            # mask and token types are derived in-jit from the id plane
+            # (infer/score.py); building them host-side would be wasted
+            # per-batch work
+            inputs = {"input_ids": ids}
+        else:
+            inputs = self._host_arrays(ids, lengths)
+        inputs = pad_trailing_batch(inputs, batch)
+
+        with self.mesh:
+            dev = self._wire_pack(inputs)
+            out = np.asarray(self._jit(self.params, dev))[:, :n]
+
+        self.m_batches.inc()
+        self.m_last_batch_rows.set(n)
+        self.m_occupancy.observe(n / batch)
+        self.m_padding_waste.observe(
+            1.0 - float(lengths.sum()) / float(batch * seq))
+
+        decoded = {k: out[i] for i, k in enumerate(OUT_KEYS)}
+        for i, w in enumerate(works):
+            ref: _ChunkRef = w.payload
+            row = {k: float(decoded[k][i]) for k in OUT_KEYS}
+            if ref.ticket._offer(ref.idx, row):
+                self._finalize(ref.ticket)
+
+    def _fail_batch(self, works: Sequence[ChunkWork], exc: BaseException) -> None:
+        failed = set()
+        for w in works:
+            ticket = w.payload.ticket
+            if id(ticket) not in failed:
+                failed.add(id(ticket))
+                ticket._fail(exc)
+        self.m_failed.inc(len(failed))
+
+    # -- reduction (predictor.py:63-87 parity) ---------------------------------
+
+    def _finalize(self, ticket: RequestTicket) -> None:
+        """Reduce chunk outputs to the per-request best span, applying the
+        predictor's validity rules in chunk order (ties resolve to the
+        later chunk, exactly as the predictor's sequential stream does)."""
+        best_score = 0.0   # predictor: defaultdict(int) floor of 0
+        best: Optional[Tuple[int, dict]] = None
+        for idx in range(ticket.n_chunks):
+            row = ticket._outputs[idx]
+            start_id = int(row["start_ids"])
+            end_id = int(row["end_ids"])
+            score = row["scores"]
+            if start_id > end_id:
+                continue
+            # answer must not start inside "[CLS] question [SEP]"
+            if start_id < ticket.question_len + 2:
+                continue
+            if best_score > score:
+                continue
+            best_score = score
+            best = (idx, row)
+
+        latency = time.perf_counter() - ticket.created_at
+        if best is None:
+            result = QAResult(
+                answer="", label="unknown", score=0.0, start=-1, end=-1,
+                n_chunks=ticket.n_chunks, latency_ms=latency * 1e3,
+            )
+        else:
+            idx, row = best
+            start_id = int(row["start_ids"])
+            end_id = int(row["end_ids"])
+            label = RawPreprocessor.id2labels[int(row["labels"])]
+            if label in ("yes", "no"):
+                answer = label
+            elif label == "unknown":
+                answer = ""
+            else:
+                span = ticket.chunks[idx][start_id: end_id + 1]
+                answer = self.tokenizer.decode(span)
+            result = QAResult(
+                answer=answer, label=label, score=float(row["scores"]),
+                start=start_id, end=end_id, n_chunks=ticket.n_chunks,
+                latency_ms=latency * 1e3,
+            )
+        self.m_completed.inc()
+        self.m_latency.observe(latency)
+        ticket._finish(result)
+
+    # -- metrics / shutdown ----------------------------------------------------
+
+    def render_metrics(self) -> str:
+        for gauge, q in ((self.m_latency_p50, 0.5),
+                         (self.m_latency_p95, 0.95),
+                         (self.m_latency_p99, 0.99)):
+            v = self.m_latency.quantile(q)
+            if v is not None:
+                gauge.set(v)
+        return self.metrics.render()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop admissions, flush every admitted request to completion."""
+        self._closed = True
+        ok = self.batcher.drain(timeout=timeout)
+        return ok
+
+    def close(self, timeout: float = 30.0) -> None:
+        self._closed = True
+        self.batcher.close(timeout=timeout)

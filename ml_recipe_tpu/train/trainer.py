@@ -44,6 +44,7 @@ from ..metrics import AverageMeter
 from ..resilience.faults import fire as _fault
 from ..parallel import build_mesh, gather_to_host, make_global_array, shard_params
 from ..parallel.sharding import is_single_device
+from ..utils.hbm import device_hbm_bytes, preflight_bytes
 from ..utils.pipeline import LaggedConsumer
 from ..utils.profiler import time_profiler
 from . import loss_scale as ls_lib
@@ -61,38 +62,12 @@ except Exception:  # noqa: BLE001
     tqdm = None
 
 
-def _device_hbm_bytes() -> Optional[int]:
-    """Per-device HBM capacity in bytes, or ``None`` when the backend does
-    not report one (CPU; some simulators) — the pre-flight planner then
-    stands down rather than guessing."""
-    try:
-        stats = jax.local_devices()[0].memory_stats()
-    except Exception:  # noqa: BLE001 - absent API = no limit knowledge
-        return None
-    if not stats:
-        return None
-    limit = stats.get("bytes_limit")
-    return int(limit) if limit else None
-
-
-def _preflight_bytes(memory_analysis) -> Optional[int]:
-    """Projected per-device HBM requirement of a compiled step: arguments +
-    outputs + temporaries, minus the donated-buffer aliasing (params and
-    optimizer state are donated, so their output copies reuse the argument
-    buffers). ``None`` when the analysis is unavailable or malformed — the
-    planner then stands down instead of acting on garbage."""
-    if memory_analysis is None:
-        return None
-    try:
-        need = (
-            int(memory_analysis.argument_size_in_bytes)
-            + int(memory_analysis.output_size_in_bytes)
-            + int(memory_analysis.temp_size_in_bytes)
-            - int(getattr(memory_analysis, "alias_size_in_bytes", 0))
-        )
-    except (AttributeError, TypeError, ValueError):
-        return None
-    return need if need > 0 else None
+# The HBM byte arithmetic (device_hbm_bytes / preflight_bytes) lives in
+# utils/hbm.py, shared with serve/engine.py's predict-step pre-flight — one
+# definition of "projected per-device bytes" for train and predict steps.
+# Private aliases keep this module's historical names importable.
+_device_hbm_bytes = device_hbm_bytes
+_preflight_bytes = preflight_bytes
 
 
 def _console_str(meters: dict) -> str:

@@ -182,3 +182,37 @@ def test_widen_positions_for_long_bench(bench):
     rob = MODEL_PRESETS["roberta-base"]  # offset 2, table 514
     assert bench._widen_positions(rob, 512) is rob
     assert bench._widen_positions(rob, 1024).max_position_embeddings == 1026
+
+
+def test_bench_serve_emits_closed_loop_latency_json(bench, capsys):
+    """ISSUE-3 satellite: ``bench.py --mode serve`` drives the serving
+    engine closed-loop and emits p50/p95/p99 latency, throughput, and
+    batch-occupancy in the JSON line."""
+    import types
+
+    args = types.SimpleNamespace(
+        model="bert-tiny",
+        serve_buckets="4x64",
+        serve_clients=2,
+        serve_requests=6,
+        serve_queue_size=32,
+        max_batch_delay_ms=5.0,
+        doc_stride=32,
+        ln_impl="xla",
+        hbm_preflight=False,
+    )
+    bench.bench_serve(args)
+    out = capsys.readouterr().out.strip().splitlines()
+    parsed = json.loads(out[-1])  # the driver parses the last stdout line
+    assert parsed["metric"] == "bert-tiny_qa_serve_p95_ms"
+    assert parsed["unit"] == "ms"
+    assert parsed["requests"] == 6 and parsed["failed"] == 0
+    assert parsed["p50_ms"] > 0
+    assert parsed["p50_ms"] <= parsed["p95_ms"] <= parsed["p99_ms"]
+    assert parsed["value"] == parsed["p95_ms"]
+    assert parsed["throughput_rps"] > 0
+    assert parsed["batches"] >= 1
+    assert 0 < parsed["batch_occupancy_mean"] <= 1
+    assert 0 <= parsed["padding_waste_mean"] < 1
+    assert parsed["buckets"] == ["4x64"]
+    assert parsed["autotune_probes"] == 0

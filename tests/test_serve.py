@@ -1,0 +1,802 @@
+"""Online serving subsystem tests (ml_recipe_tpu/serve/).
+
+Tier-1 coverage of the ISSUE-3 acceptance surface on the CPU mesh:
+bucket-grid admission, micro-batcher deadline/coalescing and queue-full
+backpressure, Prometheus text rendering, the predict-step HBM pre-flight
+(grid shrinking, mocked memory_analysis), end-to-end requests through a
+tiny model over HTTP, batch-predictor span parity for identical inputs,
+and zero-probe warmup through the autotune cache.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+
+from ml_recipe_tpu.config.parser import (
+    get_model_parser,
+    get_params,
+    get_serve_parser,
+)
+from ml_recipe_tpu.data import RawPreprocessor
+from ml_recipe_tpu.data.datasets import ChunkDataset
+from ml_recipe_tpu.infer import Predictor
+from ml_recipe_tpu.models import EncoderConfig, QAModel
+from ml_recipe_tpu.parallel import build_mesh
+from ml_recipe_tpu.serve.batcher import (
+    ChunkWork,
+    DrainingError,
+    MicroBatcher,
+    QueueFullError,
+)
+from ml_recipe_tpu.serve.bucketing import (
+    Bucket,
+    BucketGrid,
+    pad_trailing_batch,
+    parse_bucket_spec,
+)
+from ml_recipe_tpu.serve.metrics import Histogram, Registry
+
+from helpers import make_tokenizer, nq_line, write_corpus
+
+_REPO = Path(__file__).resolve().parents[1]
+
+
+# ---------------------------------------------------------------------------
+# bucketing
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.unit
+def test_parse_bucket_spec_sorts_and_dedups():
+    buckets = parse_bucket_spec("8x384, 4X64,8x384,8*64")
+    assert buckets == [
+        Bucket(seq=64, batch=4), Bucket(seq=64, batch=8),
+        Bucket(seq=384, batch=8),
+    ]
+    assert str(buckets[0]) == "4x64"
+
+
+@pytest.mark.unit
+@pytest.mark.parametrize("bad", ["", "8y64", "x64", "0x64", "4x4", "8x"])
+def test_parse_bucket_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_bucket_spec(bad)
+
+
+@pytest.mark.unit
+def test_grid_admission_and_batch_selection():
+    grid = BucketGrid.from_spec("2x64,8x64,4x128")
+    # smallest seq bucket that fits
+    assert grid.admit(10) == 64
+    assert grid.admit(64) == 64
+    assert grid.admit(65) == 128
+    assert grid.admit(129) is None  # over-long never compiles fresh
+    # smallest batch >= n at a seq; largest when nothing fits
+    assert grid.batch_for(64, 1) == 2
+    assert grid.batch_for(64, 3) == 8
+    assert grid.batch_for(64, 9) == 8
+    assert grid.max_batch_for(128) == 4
+    assert grid.max_seq == 128
+    assert len(grid) == 3
+
+
+@pytest.mark.unit
+def test_grid_drop_never_empties():
+    grid = BucketGrid.from_spec("2x64,4x128")
+    assert grid.drop(Bucket(seq=64, batch=2))
+    assert grid.seqs == [128]
+    # the last bucket is load-bearing: refuse to drop it
+    assert not grid.drop(Bucket(seq=128, batch=4))
+    assert list(grid) == [Bucket(seq=128, batch=4)]
+    # unknown bucket is a no-op
+    assert not grid.drop(Bucket(seq=512, batch=1))
+
+
+@pytest.mark.unit
+def test_pad_trailing_batch_repeats_last_row():
+    rng = np.random.default_rng(0)
+    inputs = {
+        "input_ids": rng.integers(0, 50, (3, 8), dtype=np.int32),
+        "attention_mask": rng.integers(0, 2, (3, 8), dtype=np.int32),
+    }
+    out = pad_trailing_batch(inputs, 5)
+    for k in inputs:
+        assert out[k].shape == (5, 8)
+        assert np.array_equal(out[k][:3], inputs[k])
+        assert np.array_equal(out[k][3], inputs[k][-1])
+        assert np.array_equal(out[k][4], inputs[k][-1])
+    # full batch: identity (no copy, no concat)
+    assert pad_trailing_batch(inputs, 3) is inputs
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.unit
+def test_metrics_render_prometheus_text():
+    reg = Registry()
+    c = reg.counter("qa_x_total", "Things.")
+    g = reg.gauge("qa_depth", "Depth.")
+    h = reg.histogram("qa_lat_seconds", "Latency.", buckets=(0.1, 1.0))
+    c.inc()
+    c.inc(2)
+    g.set(7)
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(3.0)
+    text = reg.render()
+    assert "# TYPE qa_x_total counter" in text
+    assert "qa_x_total 3" in text
+    assert "# TYPE qa_depth gauge" in text
+    assert "qa_depth 7" in text
+    # cumulative buckets + +Inf + sum/count
+    assert 'qa_lat_seconds_bucket{le="0.1"} 1' in text
+    assert 'qa_lat_seconds_bucket{le="1"} 2' in text
+    assert 'qa_lat_seconds_bucket{le="+Inf"} 3' in text
+    assert "qa_lat_seconds_count 3" in text
+    with pytest.raises(ValueError):
+        reg.counter("qa_x_total", "dup")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+@pytest.mark.unit
+def test_histogram_quantiles():
+    h = Histogram("h", "h")
+    assert h.quantile(0.5) is None
+    for v in range(1, 101):
+        h.observe(float(v))
+    assert h.quantile(0.0) == 1.0
+    assert h.quantile(1.0) == 100.0
+    assert abs(h.quantile(0.5) - 50.5) < 1e-9
+    assert h.count == 100
+    assert abs(h.mean - 50.5) < 1e-9
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher (stub run_fn — no jax)
+# ---------------------------------------------------------------------------
+
+
+def _works(n, seq=64):
+    return [ChunkWork(seq=seq, payload=i) for i in range(n)]
+
+
+@pytest.mark.unit
+def test_batcher_full_bucket_fires_before_deadline():
+    grid = BucketGrid.from_spec("2x64")
+    ran = threading.Event()
+    batches = []
+
+    def run(seq, works):
+        batches.append((seq, len(works)))
+        ran.set()
+
+    b = MicroBatcher(grid, run, max_batch_delay_ms=10_000, queue_size=16)
+    b.start()
+    t0 = time.monotonic()
+    b.submit_many(_works(2))
+    assert ran.wait(5.0), "full bucket did not fire"
+    # a 10s deadline was configured: firing fast proves the full-bucket
+    # fast path, not the deadline
+    assert time.monotonic() - t0 < 5.0
+    assert batches == [(64, 2)]
+    b.close()
+
+
+@pytest.mark.unit
+def test_batcher_deadline_coalesces_partial_bucket():
+    grid = BucketGrid.from_spec("8x64")
+    done = threading.Event()
+    batches = []
+
+    def run(seq, works):
+        batches.append((time.monotonic(), len(works)))
+        done.set()
+
+    b = MicroBatcher(grid, run, max_batch_delay_ms=120, queue_size=16)
+    b.start()
+    t0 = time.monotonic()
+    b.submit_many(_works(2))
+    b.submit_many(_works(1))
+    assert done.wait(5.0)
+    fired_at, rows = batches[0]
+    assert rows == 3  # both submissions coalesced into one launch
+    assert fired_at - t0 >= 0.10  # and only once the deadline expired
+    b.close()
+
+
+@pytest.mark.unit
+def test_batcher_queue_full_backpressure_and_atomicity():
+    grid = BucketGrid.from_spec("1x64")
+    started = threading.Event()
+    release = threading.Event()
+    calls = []
+
+    def run(seq, works):
+        calls.append(len(works))
+        if len(calls) == 1:
+            started.set()
+            release.wait(10)
+
+    b = MicroBatcher(grid, run, max_batch_delay_ms=0, queue_size=3)
+    b.start()
+    b.submit_many(_works(1))
+    assert started.wait(5.0)  # worker is now wedged inside batch 1
+    b.submit_many(_works(3))  # fills the bounded queue exactly
+    with pytest.raises(QueueFullError):
+        b.submit_many(_works(1))
+    # all-or-nothing admission: a 2-chunk request into 0 free slots leaves
+    # no orphan chunk behind
+    with pytest.raises(QueueFullError):
+        b.submit_many(_works(2))
+    assert b.depth == 3
+    release.set()
+    b.close()
+    assert sum(calls) == 4  # every admitted chunk ran
+
+
+@pytest.mark.unit
+def test_batcher_atomic_reject_on_oversized_request():
+    grid = BucketGrid.from_spec("4x64")
+    b = MicroBatcher(grid, lambda s, w: None, queue_size=4)
+    with pytest.raises(QueueFullError):
+        b.submit_many(_works(6))
+    assert b.depth == 0
+
+
+@pytest.mark.unit
+def test_batcher_drain_rejects_new_work():
+    grid = BucketGrid.from_spec("4x64")
+    b = MicroBatcher(grid, lambda s, w: None, queue_size=4)
+    assert b.drain(timeout=1.0)
+    with pytest.raises(DrainingError):
+        b.submit_many(_works(1))
+
+
+@pytest.mark.unit
+def test_batcher_failed_batch_routes_to_fail_fn():
+    grid = BucketGrid.from_spec("2x64")
+    failed = []
+    done = threading.Event()
+
+    def run(seq, works):
+        raise RuntimeError("device on fire")
+
+    def fail(works, exc):
+        failed.append((len(works), str(exc)))
+        done.set()
+
+    b = MicroBatcher(grid, run, max_batch_delay_ms=0, queue_size=8,
+                     fail_fn=fail)
+    b.start()
+    b.submit_many(_works(2))
+    assert done.wait(5.0)
+    assert failed == [(2, "device on fire")]
+    # the loop survived the poisoned batch: it still accepts + runs work
+    done.clear()
+    b.submit_many(_works(1))
+    assert done.wait(5.0)
+    b.close()
+
+
+# ---------------------------------------------------------------------------
+# engine + HTTP end to end (tiny model, CPU mesh)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_model(tok, max_len=64):
+    cfg = EncoderConfig(
+        vocab_size=len(tok), hidden_size=16, num_layers=1, num_heads=2,
+        intermediate_size=32, max_position_embeddings=max_len + 2,
+        num_labels=5,
+    )
+    model = QAModel(cfg)
+    params = model.init(
+        jax.random.key(0), np.zeros((1, 8), dtype=np.int32)
+    )["params"]
+    return model, params
+
+
+_QUESTION = "what is the capital of england ?"
+_DOCUMENT = (
+    "<P> London is the capital of England . </P> "
+    "<P> Big Ben was built in the city . The river Thames runs through "
+    "London . </P>"
+)
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    """Tiny model + engine + live HTTP server, shared by the e2e tests."""
+    from ml_recipe_tpu.serve.engine import QAEngine
+    from ml_recipe_tpu.serve.server import QAServer
+
+    tmp = tmp_path_factory.mktemp("serve_e2e")
+    tok = make_tokenizer(tmp)
+    model, params = _tiny_model(tok)
+    engine = QAEngine(
+        model, params, tok,
+        grid=BucketGrid.from_spec("4x64,8x64"),
+        mesh=build_mesh(),
+        max_batch_delay_ms=40,
+        queue_size=64,
+        max_question_len=16,
+        doc_stride=24,
+    )
+    report = engine.warmup(hbm_preflight=False)
+    server = QAServer(engine, port=0, request_timeout_s=60)
+    server.start()
+    yield SimpleNamespace(
+        tok=tok, model=model, params=params, engine=engine, server=server,
+        url=f"http://{server.host}:{server.port}", warmup=report,
+    )
+    server.stop()
+    server.shutdown()
+
+
+def _post(url, payload, timeout=60):
+    req = urllib.request.Request(
+        f"{url}/v1/qa", data=json.dumps(payload).encode("utf-8"),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_concurrent_requests_coalesce_into_one_batch(stack):
+    """ISSUE acceptance: >=2 concurrent POSTs share one bucket launch,
+    asserted via the batch-occupancy metrics."""
+    batches_before = stack.engine.m_batches.value
+    occup_before = stack.engine.m_occupancy.count
+
+    results = [None, None]
+
+    def worker(i):
+        results[i] = _post(
+            stack.url, {"question": _QUESTION, "document": _DOCUMENT}
+        )
+
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    for status, body in results:
+        assert status == 200
+        assert body["label"] in RawPreprocessor.labels2id
+        assert body["n_chunks"] >= 1
+
+    assert stack.engine.m_batches.value == batches_before + 1
+    assert stack.engine.m_occupancy.count == occup_before + 1
+    assert stack.engine.m_last_batch_rows.value == 2.0
+
+
+def test_healthz_and_metrics_endpoints(stack):
+    with urllib.request.urlopen(f"{stack.url}/healthz", timeout=10) as r:
+        assert r.status == 200
+        health = json.loads(r.read())
+    assert health["status"] == "ok"
+    assert health["buckets"] == ["4x64", "8x64"]
+
+    with urllib.request.urlopen(f"{stack.url}/metrics", timeout=10) as r:
+        assert r.status == 200
+        assert "text/plain" in r.headers["Content-Type"]
+        text = r.read().decode()
+    assert text.strip(), "/metrics must be non-empty"
+    assert "# TYPE qa_requests_total counter" in text
+    assert "# TYPE qa_request_latency_seconds histogram" in text
+    assert 'qa_request_latency_seconds_bucket{le="+Inf"}' in text
+    assert "qa_batch_occupancy_sum" in text
+    assert "qa_padding_waste_ratio_count" in text
+    assert "qa_queue_depth" in text
+
+
+def test_http_error_mapping(stack, monkeypatch):
+    status, body = _post(stack.url, {"question": "", "document": "x"})
+    assert status == 400 and "error" in body
+
+    req = urllib.request.Request(
+        f"{stack.url}/v1/qa", data=b"not json",
+        headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=10)
+    assert e.value.code == 400
+
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(f"{stack.url}/nope", timeout=10)
+    assert e.value.code == 404
+
+    # queue-full backpressure surfaces as 429 + Retry-After
+    def full(question, document):
+        raise QueueFullError("work queue full (64/64)")
+
+    monkeypatch.setattr(stack.engine, "submit", full)
+    req = urllib.request.Request(
+        f"{stack.url}/v1/qa",
+        data=json.dumps(
+            {"question": _QUESTION, "document": _DOCUMENT}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=10)
+    assert e.value.code == 429
+    assert e.value.headers["Retry-After"]
+
+
+def test_http_draining_returns_503(stack):
+    stack.server._httpd.draining = True
+    try:
+        status, body = _post(
+            stack.url, {"question": _QUESTION, "document": _DOCUMENT}
+        )
+        assert status == 503
+        assert body["error"] == "draining"
+        with urllib.request.urlopen(f"{stack.url}/healthz", timeout=10) as r:
+            assert json.loads(r.read())["status"] == "draining"
+    finally:
+        stack.server._httpd.draining = False
+
+
+def test_http_keepalive_survives_early_reply_paths(stack):
+    """An early reply (503 draining) must still consume the request body,
+    or the next request on the same keep-alive connection would parse the
+    leftover bytes as its request line."""
+    import http.client
+
+    conn = http.client.HTTPConnection(
+        stack.server.host, stack.server.port, timeout=10
+    )
+    body = json.dumps({"question": _QUESTION, "document": _DOCUMENT})
+    stack.server._httpd.draining = True
+    try:
+        conn.request("POST", "/v1/qa", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 503
+        resp.read()
+        # same connection, second request: must be parsed cleanly
+        conn.request("POST", "/v1/qa", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 503
+        resp.read()
+    finally:
+        stack.server._httpd.draining = False
+        conn.close()
+
+
+def test_engine_queue_full_rejects_request_atomically(stack):
+    """Admission-level backpressure without timing games: an unstarted
+    batcher consumes nothing, so the bounded queue fills deterministically."""
+    from ml_recipe_tpu.serve.engine import QAEngine, RequestRejected
+
+    engine = QAEngine(
+        stack.model, stack.params, stack.tok,
+        grid=BucketGrid.from_spec("4x64"),
+        mesh=stack.engine.mesh,
+        queue_size=2, max_question_len=16, doc_stride=8,
+    )
+    # stride 8 over this document yields > 2 chunks — beyond the queue's
+    # TOTAL capacity, so no amount of retrying could ever admit it: that is
+    # a client error (400), not retryable backpressure
+    with pytest.raises(RequestRejected, match="queue"):
+        engine.submit(_QUESTION, _DOCUMENT * 2)
+    assert engine.batcher.depth == 0
+    assert engine.m_rejected_invalid.value == 1
+
+    # transient queue-full: feasible requests, occupied queue -> 429 class
+    t1 = engine.submit(_QUESTION, "<P> london is big . </P>")
+    t2 = engine.submit(_QUESTION, "<P> london is big . </P>")
+    assert t1.n_chunks == t2.n_chunks == 1  # admitted; batcher not started
+    with pytest.raises(QueueFullError):
+        engine.submit(_QUESTION, "<P> london is big . </P>")
+    assert engine.m_rejected_full.value == 1
+
+
+def test_engine_rejects_unservable_requests(stack):
+    from ml_recipe_tpu.serve.engine import RequestRejected
+
+    with pytest.raises(RequestRejected):
+        stack.engine.submit("", _DOCUMENT)
+    with pytest.raises(RequestRejected):
+        stack.engine.submit(_QUESTION, "")
+
+
+def test_engine_drain_rejects_then_flushes(stack):
+    """A drained engine refuses new work with DrainingError (the HTTP layer
+    maps it to 503); use a private engine so the shared stack stays live."""
+    from ml_recipe_tpu.serve.engine import QAEngine
+
+    engine = QAEngine(
+        stack.model, stack.params, stack.tok,
+        grid=BucketGrid.from_spec("4x64"),
+        mesh=stack.engine.mesh,
+        max_batch_delay_ms=5, queue_size=16, max_question_len=16,
+        doc_stride=24,
+    )
+    engine.batcher.start()  # no warmup: first batch pays the compile
+    ticket = engine.submit(_QUESTION, _DOCUMENT)
+    assert engine.drain(timeout=120)  # admitted work flushes to completion
+    result = ticket.result(timeout=1)
+    assert result.label in RawPreprocessor.labels2id
+    with pytest.raises(DrainingError):
+        engine.submit(_QUESTION, _DOCUMENT)
+    engine.close()
+
+
+def test_warmup_is_zero_probe(stack):
+    """Bucket warmup rides the autotune cache: no compile probes on CPU
+    ever, and none on a warm restart anywhere (the cache serves the
+    geometry verdicts; tests/test_autotune.py pins the cache itself)."""
+    assert stack.warmup["autotune"]["probes"] == 0
+    assert stack.warmup["buckets"] == ["4x64", "8x64"]
+    assert stack.warmup["dropped"] == []
+
+    # a "restart" (second engine, same grid, same process-wide cache):
+    # still zero probes
+    from ml_recipe_tpu.serve.engine import QAEngine
+
+    engine = QAEngine(
+        stack.model, stack.params, stack.tok,
+        grid=BucketGrid.from_spec("4x64"),
+        mesh=stack.engine.mesh, max_question_len=16,
+    )
+    report = engine.warmup(hbm_preflight=False)
+    assert report["autotune"]["probes"] == 0
+    engine.close()
+
+
+# ---------------------------------------------------------------------------
+# predict-step HBM pre-flight (mocked memory analysis, trainer-preflight style)
+# ---------------------------------------------------------------------------
+
+
+def _fake_compile_fn(bytes_per_row):
+    def compile_fn(bucket):
+        class _Compiled:
+            def memory_analysis(self):
+                return SimpleNamespace(
+                    argument_size_in_bytes=bucket.batch * bytes_per_row,
+                    output_size_in_bytes=0,
+                    temp_size_in_bytes=0,
+                    alias_size_in_bytes=0,
+                )
+        return _Compiled()
+    return compile_fn
+
+
+def test_preflight_predict_step_shrinks_grid(stack):
+    from ml_recipe_tpu.serve.engine import QAEngine
+
+    engine = QAEngine(
+        stack.model, stack.params, stack.tok,
+        grid=BucketGrid.from_spec("2x64,8x64"),
+        mesh=stack.engine.mesh, max_batch_delay_ms=5, queue_size=16,
+        max_question_len=16, doc_stride=24,
+    )
+    report = engine.warmup(
+        hbm_preflight=True, limit_bytes=3000,
+        compile_fn=_fake_compile_fn(1000),
+    )
+    # 8 rows * 1000 B > 3000 B: the 8-wide bucket is dropped, not OOMed
+    assert report["dropped"] == ["8x64"]
+    assert report["buckets"] == ["2x64"]
+    assert report["preflight"]["8x64"] == {
+        "bytes": 8000, "limit": 3000, "fits": False,
+    }
+    assert list(engine.grid) == [Bucket(seq=64, batch=2)]
+    # the shrunk grid still serves
+    ticket = engine.submit(_QUESTION, "<P> london is big . </P>")
+    assert ticket.result(timeout=60).label in RawPreprocessor.labels2id
+    engine.close()
+
+
+def test_preflight_predict_step_keeps_last_bucket(stack):
+    from ml_recipe_tpu.serve.engine import QAEngine
+
+    engine = QAEngine(
+        stack.model, stack.params, stack.tok,
+        grid=BucketGrid.from_spec("2x64,4x64"),
+        mesh=stack.engine.mesh, max_question_len=16,
+    )
+    report = engine.warmup(
+        hbm_preflight=True, limit_bytes=10,
+        compile_fn=_fake_compile_fn(1000),
+    )
+    # everything exceeds the limit; the grid never shrinks to nothing
+    assert report["dropped"] == ["2x64"]
+    assert report["buckets"] == ["4x64"]
+    engine.close()
+
+
+def test_preflight_predict_step_stands_down_without_limit(stack):
+    """CPU reports no HBM limit: the planner must do nothing (and compile
+    nothing extra) rather than guess."""
+    verdict = stack.engine.preflight_predict_step(
+        Bucket(seq=64, batch=4),
+        compile_fn=lambda b: pytest.fail("must not compile without a limit"),
+    )
+    assert verdict is None
+
+
+# ---------------------------------------------------------------------------
+# batch-predictor parity: same inputs, same spans
+# ---------------------------------------------------------------------------
+
+
+def test_serving_spans_match_batch_predictor(stack, tmp_path):
+    """ISSUE acceptance: serving answers match infer/predictor.py for the
+    same (question, document) inputs. The engine is configured with the
+    SAME chunk geometry as the ChunkDataset (window mode, same stride /
+    max_seq_len / max_question_len), so chunk sets are identical and the
+    shared score_fn makes per-chunk outputs identical — compared here both
+    at the reduced-candidate level and raw per-chunk scores."""
+    from ml_recipe_tpu.data.collate import collate_fun
+    import functools
+
+    lines = [
+        nq_line(example_id=str(i),
+                question_text=_QUESTION,
+                document_text=_DOCUMENT if i % 2 else
+                "<P> the quick brown fox jumps over the lazy dog . "
+                "the river thames runs through london . </P>")
+        for i in range(6)
+    ]
+    corpus = write_corpus(tmp_path, lines)
+    pre = RawPreprocessor(corpus, tmp_path / "proc")
+    _, _, (train_idx, _, val_idx, _) = pre()
+    indexes = np.concatenate([train_idx, val_idx])
+
+    ds = ChunkDataset(
+        tmp_path / "proc", stack.tok, indexes,
+        max_seq_len=64, max_question_len=16, doc_stride=24,
+        split_by_sentence=False, truncate=False,
+    )
+    collate = functools.partial(
+        collate_fun, tokenizer=stack.tok, max_seq_len=64, return_items=True
+    )
+    predictor = Predictor(
+        stack.model, stack.params, mesh=stack.engine.mesh,
+        collate_fun=collate, batch_size=8, n_jobs=2, buffer_size=64,
+    )
+    predictor(ds, save_dump=True)
+
+    # raw per-chunk outputs keyed by (doc id, chunk window start)
+    pred_chunks = {}
+    for scores, start_ids, end_ids, labels, items in predictor.dump:
+        for i, item in enumerate(items):
+            pred_chunks[(item.item_id, item.chunk_start)] = (
+                float(scores[i]), int(start_ids[i]), int(end_ids[i]),
+                int(labels[i]),
+            )
+
+    by_id = {line["example_id"]: line for line in lines}
+    for doc_id, line in by_id.items():
+        ticket = stack.engine.submit(
+            line["question_text"], line["document_text"]
+        )
+        result = ticket.result(timeout=120)
+
+        # raw score parity, chunk by chunk (engine chunk idx * stride is
+        # the window start, the ChunkDataset's chunk_start)
+        assert result.n_chunks >= 1
+        for idx in range(ticket.n_chunks):
+            row = ticket._outputs[idx]
+            key = (doc_id, idx * 24)
+            assert key in pred_chunks, f"chunk set diverged at {key}"
+            p_score, p_start, p_end, p_label = pred_chunks[key]
+            assert int(row["start_ids"]) == p_start
+            assert int(row["end_ids"]) == p_end
+            assert int(row["labels"]) == p_label
+            assert np.isclose(row["scores"], p_score, rtol=1e-5, atol=1e-5)
+
+        # reduced candidate parity (validity rules + tie semantics)
+        cand = predictor.candidates.get(doc_id)
+        if cand is None:
+            assert result.label == "unknown"
+            assert result.start == -1 and result.end == -1
+        else:
+            assert result.start == cand.start_id
+            assert result.end == cand.end_id
+            assert RawPreprocessor.labels2id[result.label] == cand.label
+            assert np.isclose(
+                result.score, predictor.scores[doc_id], rtol=1e-5, atol=1e-5
+            )
+
+
+class _StubSpanModel:
+    """Deterministic spans (mirrors test_predictor.StubSpanModel): argmax at
+    (10, 12), class 2 ('short') — pins the reduction + answer decoding."""
+
+    def apply(self, variables, input_ids, attention_mask=None,
+              token_type_ids=None, *, deterministic=True):
+        import jax.numpy as jnp
+
+        B, L = input_ids.shape
+        start = jnp.zeros((B, L)).at[:, 10].set(5.0)
+        end = jnp.zeros((B, L)).at[:, 12].set(5.0)
+        cls_logits = jnp.zeros((B, 5)).at[:, 2].set(3.0)
+        return {
+            "start_class": start,
+            "end_class": end,
+            "start_reg": jnp.full((B,), 0.25),
+            "end_reg": jnp.full((B,), 0.75),
+            "cls": cls_logits,
+        }
+
+
+def test_engine_decodes_winning_span_text(stack):
+    from ml_recipe_tpu.serve.engine import QAEngine
+
+    engine = QAEngine(
+        _StubSpanModel(), {}, stack.tok,
+        grid=BucketGrid.from_spec("2x64"),
+        mesh=stack.engine.mesh, max_batch_delay_ms=5, queue_size=16,
+        max_question_len=16, doc_stride=24,
+    )
+    engine.batcher.start()
+    ticket = engine.submit(_QUESTION, "<P> london is the capital . </P>")
+    result = ticket.result(timeout=60)
+    assert result.n_chunks == 1
+    assert result.label == "short"
+    assert (result.start, result.end) == (10, 12)
+    # answer text is the decoded winning span of the chunk's own tokens
+    expected = stack.tok.decode(ticket.chunks[0][10:13])
+    assert result.answer == expected
+    assert expected  # non-empty: the span lands inside the document
+    engine.close()
+
+
+# ---------------------------------------------------------------------------
+# config plumbing + lint-gate coverage
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.unit
+def test_serve_parser_reads_example_config():
+    cfg = _REPO / "config" / "serve.cfg"
+    _, (params, model_params) = get_params(
+        (get_serve_parser, get_model_parser),
+        args=["-c", str(cfg), "--port", "0"],
+    )
+    grid = BucketGrid.from_spec(params.buckets)
+    assert grid.seqs == [128, 384]
+    assert params.port == 0  # CLI wins over the file
+    assert params.max_batch_delay_ms == 10.0
+    assert params.queue_size == 256
+    assert params.hbm_preflight is True
+    assert model_params.model == "bert-base-uncased"
+
+
+@pytest.mark.unit
+def test_bare_except_gate_covers_serve_package():
+    """scripts/check_bare_except.sh greps ml_recipe_tpu/ recursively;
+    serve/ lives under it, so the tier-1 gate (test_lint.py) covers the
+    new package. Pin the assumptions that coverage rests on."""
+    serve_dir = _REPO / "ml_recipe_tpu" / "serve"
+    assert serve_dir.is_dir()
+    assert {p.name for p in serve_dir.glob("*.py")} >= {
+        "bucketing.py", "batcher.py", "engine.py", "server.py", "metrics.py",
+    }
+    script = (_REPO / "scripts" / "check_bare_except.sh").read_text()
+    assert "ml_recipe_tpu/" in script and "-r" in script
