@@ -35,6 +35,7 @@ from ..config.parser import (
     write_config_file,
 )
 from ..data import RawPreprocessor
+from ..data.bucketing import parse_length_buckets
 from ..parallel import barrier, build_mesh, initialize_from_params, is_primary
 from ..train import AccuracyCallback, MAPCallback, SaveBestCallback, Trainer
 from ..utils.logging import get_logger, show_params
@@ -160,6 +161,11 @@ def _run_worker(params, model_params, watchdog) -> None:
         ),
         watchdog=watchdog,
         hbm_preflight=getattr(params, "hbm_preflight", True),
+        length_buckets=parse_length_buckets(
+            getattr(params, "length_buckets", None), params.max_seq_len
+        ),
+        device_prefetch=getattr(params, "device_prefetch", 0),
+        log_every=getattr(params, "log_every", 10),
     )
 
     if params.last is not None:

@@ -20,6 +20,7 @@ import os
 
 from ..compose import init_collate_fun, init_model, init_validation_dataset
 from ..config.parser import get_model_parser, get_params, get_predictor_parser
+from ..data.bucketing import parse_length_buckets
 from ..infer import Predictor
 from ..parallel import build_mesh
 from ..utils.logging import get_logger, show_params
@@ -48,6 +49,9 @@ def main(params, model_params):
         buffer_size=params.buffer_size,
         limit=params.limit,
         fetch_every=params.fetch_every,
+        length_buckets=parse_length_buckets(
+            getattr(params, "length_buckets", None), params.max_seq_len
+        ),
     )
 
     predictor(val_dataset)

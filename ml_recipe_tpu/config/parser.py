@@ -398,6 +398,31 @@ def get_trainer_parser() -> ConfigArgumentParser:
 
     parser.add_argument("--warmup_coef", type=float, default=0.05, help="Warmup coefficient.")
 
+    # Padding-free input pipeline (data/bucketing.py + data/device_prefetch.py).
+    parser.add_argument("--length_buckets", type=str, default="off",
+                        help="Length-bucketed token-budget batching: 'off' "
+                             "(pad every batch to max_seq_len — historical "
+                             "behavior), 'auto' (evenly spaced seq grid "
+                             "ending at max_seq_len, e.g. 128,256,384,512), "
+                             "or explicit comma-separated seq edges. Batches "
+                             "pad to their BUCKET and the per-bucket batch "
+                             "size scales inversely with seq (constant "
+                             "token budget per step); one compiled program "
+                             "per occupied bucket. Single-process only.")
+    parser.add_argument("--device_prefetch", type=int, default=0,
+                        help="Double-buffered device prefetch depth: keep "
+                             "this many placed global batches in flight on "
+                             "a background thread so the host->device copy "
+                             "of step k+1 overlaps compute of step k. 0 = "
+                             "synchronous placement (historical behavior); "
+                             "2 is the intended on-chip setting. The "
+                             "trajectory is bit-identical either way.")
+    parser.add_argument("--log_every", type=int, default=10,
+                        help="Steps between tqdm-postfix/TensorBoard writes "
+                             "in the train loop (meters still update every "
+                             "step; the epoch's final state is always "
+                             "written).")
+
     # Kernel geometry autotuner + HBM pre-flight planner (measured
     # configuration over analytic byte-counting).
     parser.add_argument("--autotune", type=_str2bool, default=True,
@@ -548,6 +573,14 @@ def get_predictor_parser() -> ConfigArgumentParser:
 
     parser.add_argument("--gpu_compat", action="store_true",
                         help="Accepted for reference-config compatibility.")
+
+    parser.add_argument("--length_buckets", type=str, default="off",
+                        help="Length-bucketed chunk batching for offline "
+                             "eval: 'off', 'auto', or comma-separated seq "
+                             "edges (see the trainer flag). Chunks pad to "
+                             "their bucket instead of max_seq_len; the "
+                             "per-bucket batch size holds the token budget "
+                             "batch_size * max_seq_len constant.")
 
     return parser
 

@@ -1,7 +1,16 @@
 from .preprocessor import LineDataExtractor, RawPreprocessor
 from .datasets import DatasetItem, ChunkItem, SplitDataset, ChunkDataset, DummyDataset
-from .collate import collate_fun, make_collate_fun
+from .collate import collate_fun, make_collate_fun, rebind_collate_seq
 from .loader import DataLoader, ListDataloader, ShardedBatchSampler
+from .bucketing import (
+    BucketedBatch,
+    BucketedDataLoader,
+    TokenBudgetBucketer,
+    auto_seq_grid,
+    bucket_batch_sizes,
+    parse_length_buckets,
+)
+from .device_prefetch import DevicePrefetcher
 
 __all__ = [
     "LineDataExtractor",
@@ -13,7 +22,15 @@ __all__ = [
     "DummyDataset",
     "collate_fun",
     "make_collate_fun",
+    "rebind_collate_seq",
     "DataLoader",
     "ListDataloader",
     "ShardedBatchSampler",
+    "BucketedBatch",
+    "BucketedDataLoader",
+    "TokenBudgetBucketer",
+    "auto_seq_grid",
+    "bucket_batch_sizes",
+    "parse_length_buckets",
+    "DevicePrefetcher",
 ]

@@ -1,0 +1,361 @@
+"""Length-bucketed token-budget batching for the training/eval input path.
+
+The collate path pads every batch to the static global ``max_seq_len``
+(collate.py) so one compiled program serves the whole run — but NQ
+sliding-window chunks are mostly shorter than the cap, and every pad token
+burns real attention+FFN FLOPs on the device. The serving subsystem already
+solved this with a SMALL FIXED GRID of pre-compiled shapes
+(serve/bucketing.py); this module brings the same discipline to training and
+offline eval:
+
+- items are routed to the smallest bucket seq that fits them and padded only
+  to the BUCKET, not the global max;
+- the per-bucket batch size scales inversely with the bucket seq so every
+  step carries (approximately) the same number of tokens — the TOKEN BUDGET
+  — keeping step time and HBM footprint roughly constant across buckets;
+- the whole epoch is served by ``len(grid)`` compiled programs (jit caches
+  one executable per input shape; the PR-2 autotune cache makes each bucket
+  compile zero-probe on a warm restart).
+
+Sampling-order preservation: the bucketed loader walks the SAME deterministic
+epoch ordering the ``ShardedBatchSampler`` draws (shuffled or
+weighted-with-replacement), assigning items to buckets in that order — so
+answer upsampling and epoch determinism survive; only batch *composition*
+changes (each batch is drawn from one bucket's arrival queue).
+
+Multi-host note: bucket composition depends on item CONTENT (lengths), which
+each host would have to know for the full global ordering to keep step shapes
+in lockstep; that coordination is future work, so the bucketed loader is
+single-process (the Trainer falls back to pad-to-max batching on multi-host
+meshes, with a warning).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from .collate import rebind_collate_seq
+from .loader import _read_with_retry
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_NUM_BUCKETS = 4
+
+
+def auto_seq_grid(max_seq_len: int, n_buckets: int = DEFAULT_NUM_BUCKETS) -> List[int]:
+    """Evenly spaced seq grid ending exactly at ``max_seq_len``, each edge
+    rounded UP to a multiple of 8 (lane-friendly shapes; rounding down could
+    strand items between buckets). max 512 -> [128, 256, 384, 512]."""
+    if max_seq_len < 8:
+        return [int(max_seq_len)]
+    grid = set()
+    for k in range(1, max(1, n_buckets) + 1):
+        edge = int(-(-(max_seq_len * k) // (n_buckets * 8)) * 8)  # ceil to 8
+        grid.add(min(edge, int(max_seq_len)))
+    grid.add(int(max_seq_len))
+    return sorted(grid)
+
+
+def parse_length_buckets(spec, max_seq_len: Optional[int] = None) -> Optional[List[int]]:
+    """Flag domain of ``--length_buckets``: ``off``/``none``/``0`` (or None)
+    -> None (pad-to-max batching, exactly today's behavior); ``auto`` ->
+    :func:`auto_seq_grid`; ``"128,256,384"`` -> explicit edges. A list/tuple
+    passes through. When ``max_seq_len`` is known the grid is extended to
+    cover it — an item longer than every bucket would have nowhere to go."""
+    if spec is None:
+        return None
+    if isinstance(spec, (list, tuple)):
+        grid = [int(s) for s in spec]
+    else:
+        s = str(spec).strip().lower()
+        if s in ("off", "none", "0", "false", ""):
+            return None
+        if s == "auto":
+            if max_seq_len is None:
+                raise ValueError("length_buckets=auto requires max_seq_len")
+            grid = auto_seq_grid(int(max_seq_len))
+        else:
+            try:
+                grid = [int(p) for p in s.split(",") if p.strip()]
+            except ValueError:
+                raise ValueError(
+                    f"bad length_buckets spec {spec!r} (want 'off', 'auto', "
+                    f"or comma-separated seq edges like '128,256,384,512')"
+                ) from None
+    if not grid:
+        return None
+    if any(g < 8 for g in grid):
+        raise ValueError(f"length_buckets edges must be >= 8, got {sorted(grid)}")
+    grid = sorted(set(grid))
+    if max_seq_len is not None:
+        if grid[-1] > int(max_seq_len):
+            # a bucket past the static cap would pad batches beyond the
+            # model's position table — hard error, never a silent clamp
+            # (the repo-wide position-table convention)
+            raise ValueError(
+                f"length_buckets edge {grid[-1]} exceeds max_seq_len "
+                f"{int(max_seq_len)} (batches would outgrow the model's "
+                f"position table)"
+            )
+        if grid[-1] < int(max_seq_len):
+            grid.append(int(max_seq_len))
+    return grid
+
+
+def bucket_batch_sizes(
+    seq_grid: Sequence[int], token_budget: int, *, multiple: int = 1
+) -> Dict[int, int]:
+    """Per-bucket batch sizes holding ``batch * seq`` at (or just under) the
+    token budget, rounded DOWN to ``multiple`` (the product of ``batch_split``
+    and the mesh data-axis size — every bucket batch must micro-split and
+    shard exactly like the pad-to-max batch does). Never below ``multiple``:
+    a bucket must stay runnable even when the budget is too small for it."""
+    multiple = max(1, int(multiple))
+    sizes = {}
+    for seq in seq_grid:
+        b = (int(token_budget) // int(seq)) // multiple * multiple
+        sizes[int(seq)] = max(b, multiple)
+    return sizes
+
+
+class BucketedBatch(NamedTuple):
+    """One collated batch padded to its bucket: ``rows`` total rows of
+    ``seq`` tokens, of which the first ``real_rows`` are real examples (the
+    rest repeat the last real row — eval tail padding; train batches are
+    always full)."""
+
+    inputs: dict
+    labels: dict
+    seq: int
+    real_rows: int
+    rows: int
+
+
+class TokenBudgetBucketer:
+    """Streaming item -> bucket accumulator (shared by the bucketed train
+    loader and the predictor's chunk batching). ``add`` returns a full
+    ``(seq, items)`` group when the item completes its bucket's batch,
+    ``flush`` drains the partial tails in grid order."""
+
+    def __init__(self, seq_grid: Sequence[int], batch_sizes: Dict[int, int]):
+        self.seq_grid = sorted(int(s) for s in seq_grid)
+        self.batch_sizes = {int(k): int(v) for k, v in batch_sizes.items()}
+        self._pending: Dict[int, list] = {s: [] for s in self.seq_grid}
+
+    def bucket_for(self, length: int) -> int:
+        """Smallest bucket seq >= ``length``; the TOP bucket for anything
+        longer (collate then enforces the hard cap, exactly as it does on
+        the unbucketed path)."""
+        for seq in self.seq_grid:
+            if length <= seq:
+                return seq
+        return self.seq_grid[-1]
+
+    def add(self, length: int, item):
+        seq = self.bucket_for(length)
+        pending = self._pending[seq]
+        pending.append(item)
+        if len(pending) >= self.batch_sizes[seq]:
+            self._pending[seq] = []
+            return seq, pending
+        return None
+
+    def flush(self):
+        for seq in self.seq_grid:
+            pending = self._pending[seq]
+            if pending:
+                self._pending[seq] = []
+                yield seq, pending
+
+
+class BucketedDataLoader:
+    """Prefetching loader producing bucket-homogeneous collated batches.
+
+    Walks ``sampler.epoch_indices(epoch)`` (the exact ordering the plain
+    :class:`~ml_recipe_tpu.data.loader.DataLoader` batches — weighted
+    sampling preserved), reads items through the same retrying thread pool,
+    and groups them by length bucket under the token budget. Train mode
+    (``pad_last=False``) drops the partial bucket tails at epoch end
+    (drop_last parity: no padding rows ever reach the loss); eval mode
+    (``pad_last=True``) pads tails by repeating the last real item and
+    reports ``real_rows`` so consumers trim before metric averaging.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        sampler,
+        collate_fun,
+        *,
+        seq_grid: Sequence[int],
+        token_budget: Optional[int] = None,
+        batch_multiple: int = 1,
+        n_jobs: int = 4,
+        read_window: Optional[int] = None,
+        read_retries: int = 3,
+        pad_last: bool = False,
+    ):
+        if getattr(sampler, "process_count", 1) != 1:
+            raise ValueError(
+                "BucketedDataLoader is single-process: bucket composition is "
+                "length-dependent and multi-host step shapes would diverge "
+                "(use the pad-to-max DataLoader on multi-host meshes)."
+            )
+        self.dataset = dataset
+        self.sampler = sampler
+        self.collate_fun = collate_fun
+        self.seq_grid = sorted(int(s) for s in seq_grid)
+        self.token_budget = int(
+            token_budget
+            if token_budget is not None
+            else sampler.global_batch_size * self.seq_grid[-1]
+        )
+        self.n_jobs = max(1, n_jobs)
+        # items kept in flight with the reader pool (covers several batches
+        # of the LARGEST-batch bucket so short-item bursts don't starve it)
+        self.read_window = (
+            int(read_window) if read_window is not None else self.n_jobs * 8
+        )
+        self.read_retries = max(0, read_retries)
+        self.pad_last = pad_last
+        self._epoch = 0
+        self._collates: Dict[int, object] = {}
+        self._last_stats: Optional[dict] = None
+        self.rescale(batch_multiple)
+
+    def rescale(self, batch_multiple: int) -> Dict[int, int]:
+        """(Re)derive the per-bucket batch sizes for a new divisibility
+        multiple — the HBM pre-flight calls this after raising
+        ``batch_split`` (must happen before iteration starts)."""
+        self.batch_multiple = max(1, int(batch_multiple))
+        self.batch_sizes = bucket_batch_sizes(
+            self.seq_grid, self.token_budget, multiple=self.batch_multiple
+        )
+        return self.batch_sizes
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    def __len__(self) -> int:
+        """UPPER-BOUND step estimate: every bucket batch carries at least
+        ``sampler.global_batch_size`` rows (batch scales inversely with
+        seq), so an epoch takes at most as many steps as the pad-to-max
+        path — which is what the LR schedule and progress displays use."""
+        return len(self.sampler)
+
+    def _collate_for(self, seq: int):
+        collate = self._collates.get(seq)
+        if collate is None:
+            collate = rebind_collate_seq(self.collate_fun, seq)
+            self._collates[seq] = collate
+        return collate
+
+    def _emit(self, seq: int, items: list, stats: dict, *, real_rows=None):
+        real = len(items) if real_rows is None else int(real_rows)
+        out = self._collate_for(seq)(items)
+        inputs, labels = out[0], out[1]
+        rows = len(items)
+        stats["real_tokens"] += sum(len(it.input_ids) for it in items[:real])
+        stats["bucket_tokens"] += rows * seq
+        stats["padmax_tokens"] += real * self.seq_grid[-1]
+        stats["batches"] += 1
+        stats["items"] += real
+        return BucketedBatch(
+            inputs=inputs, labels=labels, seq=seq, real_rows=real, rows=rows
+        )
+
+    def __iter__(self):
+        indices = [int(i) for i in self.sampler.epoch_indices(self._epoch)]
+        self._last_stats = stats = {
+            "real_tokens": 0,
+            "bucket_tokens": 0,
+            "padmax_tokens": 0,
+            "batches": 0,
+            "items": 0,
+            "dropped_items": 0,
+        }
+        bucketer = TokenBudgetBucketer(self.seq_grid, self.batch_sizes)
+        if indices:
+            with ThreadPoolExecutor(max_workers=self.n_jobs) as pool:
+
+                def read(i):
+                    return _read_with_retry(
+                        self.dataset, i, retries=self.read_retries
+                    )
+
+                futures: deque = deque()
+                it = iter(indices)
+                for idx in indices[: self.read_window]:
+                    futures.append(pool.submit(read, idx))
+                    next(it)
+                while futures:
+                    # results are consumed in SUBMISSION order — the epoch
+                    # ordering is what bucket assignment must follow
+                    item = futures.popleft().result()
+                    nxt = next(it, None)
+                    if nxt is not None:
+                        futures.append(pool.submit(read, nxt))
+                    emitted = bucketer.add(len(item.input_ids), item)
+                    if emitted is not None:
+                        yield self._emit(emitted[0], emitted[1], stats)
+        for seq, items in bucketer.flush():
+            if self.pad_last:
+                real = len(items)
+                pad = self.batch_sizes[seq] - real
+                yield self._emit(
+                    seq, items + [items[-1]] * pad, stats, real_rows=real
+                )
+            else:
+                stats["dropped_items"] += len(items)
+        if stats["dropped_items"]:
+            logger.info(
+                "Bucketed epoch dropped %d partial-bucket tail items "
+                "(drop_last parity; they re-enter next epoch's shuffle).",
+                stats["dropped_items"],
+            )
+
+    @property
+    def epoch_stats(self) -> Optional[dict]:
+        """Token accounting of the last (or in-progress) epoch:
+        ``padding_waste_pct`` is the pad-token share of what the device
+        actually ran; ``padmax_waste_pct`` is what the pad-to-max path
+        would have wasted on the same items."""
+        s = self._last_stats
+        if not s:
+            return None
+        out = dict(s)
+        if s["bucket_tokens"]:
+            out["padding_waste_pct"] = round(
+                100.0 * (1.0 - s["real_tokens"] / s["bucket_tokens"]), 2
+            )
+        if s["padmax_tokens"]:
+            out["padmax_waste_pct"] = round(
+                100.0 * (1.0 - s["real_tokens"] / s["padmax_tokens"]), 2
+            )
+        return out
+
+
+def synthetic_qa_batch(batch: int, seq: int):
+    """Shape-only host ``(inputs, labels)`` in the QA collate schema
+    (collate.py's fixed key set) — the per-bucket HBM pre-flight lowers and
+    compiles each bucket's train step from these before the first real batch
+    exists; jit caches by shape/dtype, so these compiles ARE the training
+    compiles."""
+    inputs = {
+        "input_ids": np.ones((batch, seq), dtype=np.int32),
+        "attention_mask": np.ones((batch, seq), dtype=np.int32),
+        "token_type_ids": np.zeros((batch, seq), dtype=np.int32),
+    }
+    labels = {
+        "start_class": np.zeros((batch,), dtype=np.int32),
+        "end_class": np.zeros((batch,), dtype=np.int32),
+        "start_reg": np.zeros((batch,), dtype=np.float32),
+        "end_reg": np.zeros((batch,), dtype=np.float32),
+        "cls": np.zeros((batch,), dtype=np.int32),
+    }
+    return inputs, labels

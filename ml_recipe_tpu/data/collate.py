@@ -77,3 +77,18 @@ def make_collate_fun(tokenizer, *, max_seq_len: Optional[int] = None, return_ite
     return functools.partial(
         collate_fun, tokenizer=tokenizer, max_seq_len=max_seq_len, return_items=return_items
     )
+
+
+def rebind_collate_seq(collate, max_seq_len: int):
+    """A copy of a bound collate with its static pad length replaced —
+    length-bucketed batching collates each bucket at the BUCKET seq instead
+    of the global max (data/bucketing.py), everything else (tokenizer,
+    return_items) unchanged."""
+    if not isinstance(collate, functools.partial) or collate.func is not collate_fun:
+        raise TypeError(
+            f"rebind_collate_seq needs a make_collate_fun-style partial of "
+            f"collate_fun, got {collate!r}"
+        )
+    kwargs = dict(collate.keywords)
+    kwargs["max_seq_len"] = int(max_seq_len)
+    return functools.partial(collate.func, *collate.args, **kwargs)

@@ -1,0 +1,180 @@
+"""Double-buffered device prefetch: host->device staging off the step path.
+
+The train loop used to assemble the global array for step N's batch (micro
+split + ``make_global_array``, a blocking host->device copy) on the critical
+path between dispatching step N-1 and step N — the device idled for the full
+copy every step. :class:`DevicePrefetcher` moves that placement into a
+background thread that keeps ``depth`` (default 2) placed batches in flight,
+so the H2D copy of step k+1 overlaps the device compute of step k (the
+train-side analogue of the predictor's transfer thread, and of the MPMD
+compute/transfer overlap in PAPERS.md).
+
+Guarantees the trainer's bit-identity test pins:
+
+- ORDER: one worker thread, FIFO bounded queue — batches come out in exactly
+  the order the source iterator yields them, placed by exactly the same
+  ``place_fn`` the synchronous path runs. Same arrays, same step order, same
+  trajectory.
+- ERRORS: a worker failure is captured WITH its traceback and re-raised on
+  the consumer thread as :class:`~ml_recipe_tpu.data.loader.DataLoaderWorkerError`
+  (the loader-worker convention), so the stack that actually failed is never
+  lost across the queue.
+- DRAIN: ``close()`` (also the context-manager exit and generator close)
+  stops the worker, unblocks it if it is parked on the full queue, and joins
+  it with a timeout — a worker still alive after that gets its stack logged
+  (it is the only clue to what it is wedged on) and, when no other exception
+  is already propagating, raises.
+- WATCHDOG: the consumer blocks in ``queue.get`` inside the trainer's armed
+  step frame, so a wedged prefetch thread trips the step watchdog like any
+  other stuck step — the all-thread stack dump includes this worker. The
+  ``loader.prefetch`` fault site fires per staged batch for drills.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import sys
+import threading
+import traceback
+from typing import Any, Callable, Iterable, Iterator
+
+from ..resilience.faults import fire as _fault
+from .loader import DataLoaderWorkerError
+
+logger = logging.getLogger(__name__)
+
+
+class _WorkerFailure:
+    __slots__ = ("exc", "tb")
+
+    def __init__(self, exc: BaseException, tb: str):
+        self.exc = exc
+        self.tb = tb
+
+
+class DevicePrefetcher:
+    """Iterate ``place_fn(item)`` for each item of ``source``, with the
+    placement running ``depth`` batches ahead on a background thread."""
+
+    _DONE = object()
+
+    def __init__(
+        self,
+        source: Iterable,
+        place_fn: Callable[[Any], Any],
+        *,
+        depth: int = 2,
+        join_timeout: float = 10.0,
+        name: str = "device-prefetch",
+    ):
+        self._source = source
+        self._place = place_fn
+        self.depth = max(1, int(depth))
+        self._join_timeout = join_timeout
+        self._queue: queue.Queue = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._worker, name=name, daemon=True)
+        self._started = False
+        self._closed = False
+
+    # -- worker ----------------------------------------------------------------
+
+    def _worker(self) -> None:
+        try:
+            for item in self._source:
+                if self._stop.is_set():
+                    return
+                _fault("loader.prefetch")
+                payload = (self._place(item),)
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(payload, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+        except BaseException as exc:  # noqa: BLE001 - re-raised on consumer
+            # capture the traceback HERE: the exception crosses the queue and
+            # is re-raised on the consumer thread, where this stack is gone
+            tb = traceback.format_exc()
+            logger.error(f"Device-prefetch worker failed:\n{tb}")
+            self._put_final(_WorkerFailure(exc, tb))
+        else:
+            self._put_final(self._DONE)
+
+    def _put_final(self, token) -> None:
+        while not self._stop.is_set():
+            try:
+                self._queue.put(token, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    # -- consumer --------------------------------------------------------------
+
+    def __iter__(self) -> Iterator:
+        if self._closed or self._started:
+            # single-use by design (one worker, one pass over the source):
+            # a second iteration would block forever in queue.get with no
+            # producer — fail fast instead (build a new prefetcher per epoch)
+            raise RuntimeError(
+                "DevicePrefetcher is single-use; construct a new instance "
+                "for each pass over the source iterator"
+            )
+        self._started = True
+        self._thread.start()
+        try:
+            while True:
+                got = self._queue.get()
+                if got is self._DONE:
+                    return
+                if isinstance(got, _WorkerFailure):
+                    raise DataLoaderWorkerError(
+                        f"device-prefetch worker failed: {got.exc!r}\n"
+                        f"--- worker traceback ---\n{got.tb}"
+                    ) from got.exc
+                yield got[0]
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        """Stop the worker and join it. Idempotent; safe mid-exception (a
+        still-alive worker is then only warned about — the propagating error
+        is the story, not the shutdown complaint)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        while True:  # unblock a worker parked on the full queue
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+        if not self._started:
+            return
+        self._thread.join(timeout=self._join_timeout)
+        if not self._thread.is_alive():
+            return
+        frame = sys._current_frames().get(self._thread.ident)
+        stack = (
+            "".join(traceback.format_stack(frame))
+            if frame is not None
+            else "<no frame available>"
+        )
+        logger.warning(
+            f"Prefetch thread {self._thread.name!r} still alive "
+            f"{self._join_timeout:g}s after close; its stack:\n{stack}"
+        )
+        if sys.exc_info()[0] is None:
+            raise DataLoaderWorkerError(
+                f"device-prefetch thread {self._thread.name!r} failed to "
+                f"stop within {self._join_timeout:g}s (stack logged above)"
+            )
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.close()

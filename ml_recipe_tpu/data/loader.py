@@ -160,6 +160,14 @@ class DataLoader:
     def __len__(self) -> int:
         return len(self.sampler)
 
+    def real_rows(self, batch_index: int) -> int:
+        """Number of real (non-padding) rows in the given batch — with
+        ``pad_last`` the final partial batch repeats its last index up to
+        the static shape, and consumers must exclude those rows from
+        loss/metric averaging (the bucketed loader reports the same count
+        per batch via ``BucketedBatch.real_rows``)."""
+        return self.sampler.valid_count(batch_index)
+
     def _load_batch(self, batch_indices: np.ndarray):
         items = [
             _read_with_retry(self.dataset, int(i), retries=self.read_retries)

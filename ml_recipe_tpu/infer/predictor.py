@@ -33,6 +33,12 @@ import jax
 import numpy as np
 
 from ..data import RawPreprocessor
+from ..data.bucketing import (
+    TokenBudgetBucketer,
+    bucket_batch_sizes,
+    parse_length_buckets,
+)
+from ..data.collate import rebind_collate_seq
 from ..data.loader import ListDataloader
 from ..parallel import build_mesh, gather_to_host, make_global_array
 from ..serve.bucketing import pad_trailing_batch
@@ -102,6 +108,7 @@ class Predictor:
         buffer_size: int = 4096,
         limit: Optional[int] = None,
         fetch_every: int = 1,
+        length_buckets: Optional[list] = None,
     ):
         self.model = model
         self.params = params
@@ -153,6 +160,29 @@ class Predictor:
             self._pad_id = int(tok.pad_token_id)
             self._sep_id = int(tok.sep_token_id)
             self._is_bert = getattr(tok, "model_name", "bert") == "bert"
+
+        # Length-bucketed chunk batching (data/bucketing.py): chunks pad to
+        # the smallest bucket seq that fits them instead of the collate's
+        # global max, and per-bucket batch sizes hold the token budget
+        # batch_size * max_seq constant — one compiled forward per occupied
+        # bucket. None = pad-to-max batching (historical behavior).
+        self._seq_grid = None
+        self._bucket_batches = None
+        if length_buckets:
+            max_len = getattr(self.collate_fun, "keywords", {}).get("max_seq_len")
+            grid = parse_length_buckets(length_buckets, max_len)
+            data_size = int(
+                self.mesh.shape.get("data", 1)
+                if hasattr(self.mesh, "shape") else 1
+            )
+            self._seq_grid = grid
+            self._bucket_batches = bucket_batch_sizes(
+                grid, self.batch_size * grid[-1], multiple=max(data_size, 1)
+            )
+            logger.info(
+                f"Predictor length buckets: grid {grid}, per-bucket batches "
+                f"{self._bucket_batches}."
+            )
 
         logger.info(
             f"Predictor uses mesh {dict(zip(self.mesh.axis_names, self.mesh.devices.shape))}. "
@@ -233,11 +263,13 @@ class Predictor:
         if self._jit_fwd is None:
             self._jit_fwd = self._build_fwd()
 
+        bucketed = self._seq_grid is not None
         async_dataset = ListDataloader(
             dataset,
             batch_size=self.batch_size,
             n_jobs=self.n_jobs,
-            collate_fun=self.collate_fun,
+            # bucketed: stream RAW chunk lists and collate per bucket below
+            collate_fun=None if bucketed else self.collate_fun,
             buffer_size=self.buffer_size,
             shuffle=True,
         )
@@ -283,7 +315,13 @@ class Predictor:
 
         import jax.numpy as jnp
 
-        group_n = self.fetch_every if jax.process_count() == 1 else 1
+        # Bucketed batches have per-bucket shapes, so the grouped fetch's
+        # jnp.stack cannot apply — fetch per batch there.
+        group_n = (
+            self.fetch_every
+            if jax.process_count() == 1 and not bucketed
+            else 1
+        )
 
         def drain_group(batch) -> None:
             if len(batch) == 1:
@@ -312,14 +350,51 @@ class Predictor:
         stage: queue.Queue = queue.Queue(maxsize=2)
         _DONE = object()
 
-        def transfer_worker() -> None:
-            try:
-                for batch_i, (inputs, labels, items) in enumerate(iterator):
+        def host_batches():
+            """Collated+padded host batches as ``(inputs, n_valid, items)``.
+
+            Pad-to-max path: the loader already collated at the global max;
+            pad the trailing partial batch to the static batch. Bucketed
+            path: the loader streams raw chunk lists; chunks route to the
+            smallest bucket seq that fits, each bucket collates at ITS seq
+            when its (token-budget-scaled) batch fills, and the per-bucket
+            tails flush padded with ``real`` counts — same trim discipline.
+            """
+            if not bucketed:
+                for inputs, labels, items in iterator:
                     n_valid = len(items)
                     if n_valid < self.batch_size:
                         # pad the trailing partial batch to the static shape
                         # (shared helper — serving pads rows the same way)
                         inputs = pad_trailing_batch(inputs, self.batch_size)
+                    yield inputs, n_valid, items
+                return
+            bucketer = TokenBudgetBucketer(self._seq_grid, self._bucket_batches)
+            collates = {
+                seq: rebind_collate_seq(self.collate_fun, seq)
+                for seq in self._seq_grid
+            }
+
+            def collated(seq, chunk_items):
+                inputs, _labels, chunk_items = collates[seq](chunk_items)
+                n_valid = len(chunk_items)
+                if n_valid < self._bucket_batches[seq]:
+                    inputs = pad_trailing_batch(
+                        inputs, self._bucket_batches[seq]
+                    )
+                return inputs, n_valid, chunk_items
+
+            for group in iterator:  # raw chunk lists
+                for chunk in group:
+                    emitted = bucketer.add(len(chunk.input_ids), chunk)
+                    if emitted is not None:
+                        yield collated(*emitted)
+            for seq, tail in bucketer.flush():
+                yield collated(seq, tail)
+
+        def transfer_worker() -> None:
+            try:
+                for batch_i, (inputs, n_valid, items) in enumerate(host_batches()):
                     if self._wire_ids_only:
                         packed = np.asarray(
                             inputs["input_ids"], np.uint16

@@ -25,9 +25,18 @@ class AverageMeter:
     def __call__(self) -> float:
         return self._avg_value
 
-    def update(self, value: float) -> None:
-        self._counter += 1
-        self._avg_value = (self._avg_value * (self._counter - 1) + float(value)) / self._counter
+    def update(self, value: float, n: int = 1) -> None:
+        """Fold in a mean computed over ``n`` samples. ``n=1`` is the
+        historical single-sample running mean (bit-identical arithmetic);
+        variable ``n`` makes the meter per-SAMPLE-correct when batches have
+        unequal sizes (length-bucketed batching, trimmed eval tails)."""
+        n = int(n)
+        if n <= 0:
+            return
+        self._counter += n
+        self._avg_value = (
+            self._avg_value * (self._counter - n) + float(value) * n
+        ) / self._counter
 
 
 def accuracy_score(y_true, y_pred) -> float:

@@ -144,6 +144,21 @@ def shard_params(params, mesh: Mesh, pspecs: Optional[dict] = None):
     )
 
 
+def split_micro(tree, n: int):
+    """Host ``[B, ...]`` leaves -> ``[n, B/n, ...]`` (micro-batch major) for
+    the in-step gradient-accumulation scan. Shared by the Trainer and the
+    device-prefetch placement thread — one definition of the micro layout."""
+
+    def split(x):
+        x = np.asarray(x)
+        assert x.shape[0] % n == 0, (
+            f"local batch {x.shape[0]} not divisible by batch_split {n}"
+        )
+        return x.reshape((n, x.shape[0] // n) + x.shape[1:])
+
+    return jax.tree_util.tree_map(split, tree)
+
+
 def batch_pspec(mesh: Mesh, *, shard_seq: bool = False, ndim: int = 2) -> P:
     """Spec for one batch leaf: batch dim over data, optionally seq dim over
     seq for context-parallel runs."""

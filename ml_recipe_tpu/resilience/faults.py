@@ -64,6 +64,7 @@ KNOWN_SITES = (
     "ckpt.pre_manifest",     # sharded save: shards landed, manifest not yet
     "ckpt.mid_swap",         # sharded save: between the swap's two renames
     "loader.read",           # every dataset item read (both loaders)
+    "loader.prefetch",       # device-prefetch thread, per staged batch
     "dist.rendezvous",       # before jax.distributed.initialize
     "dist.barrier",          # inside every named cross-process barrier
     "trainer.step",          # host side of each train step

@@ -65,15 +65,23 @@ class AccuracyCallback(TestCallback):
         start_idxs = start_true != -1
         end_idxs = end_true != -1
 
+        # weight each batch-mean by its row count: eval batches are NOT
+        # equal-sized (bucketed batches vary by bucket, the trimmed final
+        # batch is short), and an unweighted mean-of-means would bias the
+        # epoch accuracy toward whichever bucket had more batches
         if start_idxs.any():
             avg_meters["s_acc"].update(
-                accuracy_score(start_true[start_idxs], start_pred[start_idxs])
+                accuracy_score(start_true[start_idxs], start_pred[start_idxs]),
+                int(start_idxs.sum()),
             )
         if end_idxs.any():
             avg_meters["e_acc"].update(
-                accuracy_score(end_true[end_idxs], end_pred[end_idxs])
+                accuracy_score(end_true[end_idxs], end_pred[end_idxs]),
+                int(end_idxs.sum()),
             )
-        avg_meters["c_acc"].update(accuracy_score(cls_true, cls_pred))
+        avg_meters["c_acc"].update(
+            accuracy_score(cls_true, cls_pred), int(cls_true.shape[0])
+        )
 
     def _at_epoch_end(self, *args):
         pass

@@ -39,11 +39,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..data.bucketing import BucketedBatch, BucketedDataLoader, synthetic_qa_batch
+from ..data.device_prefetch import DevicePrefetcher
 from ..data.loader import DataLoader, ShardedBatchSampler
 from ..metrics import AverageMeter
 from ..resilience.faults import fire as _fault
 from ..parallel import build_mesh, gather_to_host, make_global_array, shard_params
-from ..parallel.sharding import is_single_device
+from ..parallel.sharding import is_single_device, split_micro
 from ..utils.hbm import device_hbm_bytes, preflight_bytes
 from ..utils.pipeline import LaggedConsumer
 from ..utils.profiler import time_profiler
@@ -148,6 +150,31 @@ class Trainer:
     # wedging. None = zero overhead.
     watchdog: Any = None
 
+    # Length-bucketed token-budget batching (data/bucketing.py): a sorted
+    # seq grid (e.g. [128, 256, 384, 512]) or None for pad-to-max batching
+    # (exactly the historical behavior). Batches are padded to their BUCKET
+    # instead of the global max and the per-bucket batch size scales
+    # inversely with seq to hold train_batch_size * max(grid) tokens per
+    # step; jit compiles one program per occupied bucket (zero probes on a
+    # warm autotune cache). Single-process only — multi-host runs fall back
+    # with a warning (bucket composition is length-dependent and step
+    # shapes would diverge across hosts).
+    length_buckets: Any = None
+
+    # Double-buffered device prefetch (data/device_prefetch.py): keep this
+    # many placed global batches in flight on a background thread so the
+    # host->device copy of step k+1 overlaps the compute of step k.
+    # 0 = synchronous placement (exactly the historical behavior). The
+    # trajectory is bit-identical either way (pinned in
+    # tests/test_device_prefetch.py).
+    device_prefetch: int = 0
+
+    # Throttle per-step host overhead: tqdm postfix + TensorBoard writes
+    # happen every `log_every` consumed steps (and once more at epoch end)
+    # instead of every step. Meters and the on_train_metrics tap still
+    # update every step — only the DISPLAY/IO cadence changes.
+    log_every: int = 10
+
     # HBM pre-flight planner: before the first train step executes, lower
     # and compile the jitted step once, read ``compiled.memory_analysis()``,
     # and if the projected HBM requirement exceeds the device limit, raise
@@ -171,6 +198,10 @@ class Trainer:
             self.n_epochs = 2
 
         # -- data loaders (trainer.py:100-114,150-181) ------------------------
+        self._seq_grid = self._resolve_seq_grid()
+        data_size = int(
+            self.mesh.shape.get("data", 1) if hasattr(self.mesh, "shape") else 1
+        )
         self.train_dataloader = None
         if self.train_dataset is not None:
             sampler_weights = None
@@ -191,10 +222,25 @@ class Trainer:
                 drop_last=True,
                 seed=self.seed,
             )
-            self.train_dataloader = DataLoader(
-                self.train_dataset, self._train_sampler, self.collate_fun,
-                n_jobs=self.n_jobs,
-            )
+            if self._seq_grid is not None:
+                self.train_dataloader = BucketedDataLoader(
+                    self.train_dataset, self._train_sampler, self.collate_fun,
+                    seq_grid=self._seq_grid,
+                    token_budget=self.train_batch_size * self._seq_grid[-1],
+                    batch_multiple=self.batch_split * max(data_size, 1),
+                    n_jobs=self.n_jobs,
+                )
+                logger.info(
+                    "Length-bucketed batching: grid %s, token budget %d, "
+                    "per-bucket batches %s.",
+                    self._seq_grid, self.train_dataloader.token_budget,
+                    self.train_dataloader.batch_sizes,
+                )
+            else:
+                self.train_dataloader = DataLoader(
+                    self.train_dataset, self._train_sampler, self.collate_fun,
+                    n_jobs=self.n_jobs,
+                )
             logger.info(f"Train dataset len: {len(self.train_dataset)}. #JOBS: {self.n_jobs}.")
 
         self.test_dataloader = None
@@ -209,10 +255,20 @@ class Trainer:
                 pad_last=True,
                 seed=self.seed,
             )
-            self.test_dataloader = DataLoader(
-                self.test_dataset, self._test_sampler, self.collate_fun,
-                n_jobs=self.n_jobs,
-            )
+            if self._seq_grid is not None:
+                self.test_dataloader = BucketedDataLoader(
+                    self.test_dataset, self._test_sampler, self.collate_fun,
+                    seq_grid=self._seq_grid,
+                    token_budget=self.test_batch_size * self._seq_grid[-1],
+                    batch_multiple=max(data_size, 1),
+                    n_jobs=self.n_jobs,
+                    pad_last=True,
+                )
+            else:
+                self.test_dataloader = DataLoader(
+                    self.test_dataset, self._test_sampler, self.collate_fun,
+                    n_jobs=self.n_jobs,
+                )
             logger.info(f"Test dataset len: {len(self.test_dataset)}. #JOBS: {self.n_jobs}.")
 
         # -- params onto the mesh --------------------------------------------
@@ -372,17 +428,41 @@ class Trainer:
         )
 
     def _split_micro(self, tree):
-        """[B_local, ...] -> [G, B_local/G, ...] for the in-step scan."""
-        g = self.batch_split
+        """[B_local, ...] -> [G, B_local/G, ...] for the in-step scan
+        (shared implementation: parallel.sharding.split_micro)."""
+        return split_micro(tree, self.batch_split)
 
-        def split(x):
-            x = np.asarray(x)
-            assert x.shape[0] % g == 0, (
-                f"local batch {x.shape[0]} not divisible by batch_split {g}"
+    def _resolve_seq_grid(self):
+        """Normalized sorted bucket grid from ``length_buckets`` (or None).
+        Extended to cover the collate's static max_seq_len (an item longer
+        than every bucket would have nowhere to go); multi-host runs fall
+        back to pad-to-max with a warning (see BucketedDataLoader)."""
+        buckets = self.length_buckets
+        if not buckets:
+            return None
+        if self.process_count > 1:
+            logger.warning(
+                "length_buckets: bucketed batching is single-process "
+                "(length-dependent batch shapes would diverge across "
+                "hosts); falling back to pad-to-max batching."
             )
-            return x.reshape((g, x.shape[0] // g) + x.shape[1:])
+            return None
+        from ..data.bucketing import parse_length_buckets
 
-        return jax.tree_util.tree_map(split, tree)
+        # one normalizer for every entry point: sort/dedupe/validate and
+        # extend the grid to cover the collate's static max_seq_len
+        max_len = getattr(self.collate_fun, "keywords", {}).get("max_seq_len")
+        return parse_length_buckets(buckets, max_len)
+
+    @staticmethod
+    def _normalize_batch(batch):
+        """Loader item -> ``(inputs, labels, meta)``; ``meta`` is the
+        BucketedBatch (bucket seq + real_rows) on the bucketed path, None on
+        the plain pad-to-max path."""
+        if isinstance(batch, BucketedBatch):
+            return batch.inputs, batch.labels, batch
+        inputs, labels = batch
+        return inputs, labels, None
 
 
     # -- HBM pre-flight planner ------------------------------------------------
@@ -506,6 +586,111 @@ class Trainer:
             # the step closed over the old batch_split — rebuild
             self._jit_train_step = None
 
+        self.preflight_report = report
+        return report
+
+    def preflight_bucket_steps(self, *, compile_fn=None, limit_bytes=None):
+        """Per-bucket HBM pre-flight — the train-side analogue of
+        ``QAEngine.preflight_predict_step``: before the first bucketed step
+        executes, lower + compile ONE train step per bucket shape (largest
+        seq first — it is the heaviest: same token count, O(L^2) attention),
+        read each ``memory_analysis()``, and if any bucket exceeds device
+        HBM, raise ``batch_split`` and re-derive every bucket's batch size
+        (``BucketedDataLoader.rescale``) before re-checking. jit caches by
+        shape, so these planning compiles are exactly the compiles the epoch
+        would pay anyway — a warm autotune cache makes them zero-probe.
+
+        ``compile_fn(trainer, seq, batch)`` / ``limit_bytes`` exist for
+        tests; both default to the real thing. Returns the report dict (also
+        ``self.preflight_report``); None when disabled or the device reports
+        no memory limit (CPU).
+        """
+        self._preflight_done = True
+        loader = self.train_dataloader
+        if not self.hbm_preflight or not isinstance(loader, BucketedDataLoader):
+            return None
+        limit = limit_bytes if limit_bytes is not None else _device_hbm_bytes()
+        if limit is None:
+            logger.info(
+                "HBM pre-flight: device reports no memory limit; skipping."
+            )
+            return None
+        data_size = int(
+            self.mesh.shape.get("data", 1) if hasattr(self.mesh, "shape") else 1
+        )
+        report = {
+            "limit_bytes": int(limit),
+            "batch_split_before": self.batch_split,
+            "batch_split": self.batch_split,
+            "buckets": [],
+            "applied": False,
+        }
+        while True:
+            if self._jit_train_step is None:
+                self._jit_train_step = self._build_train_step()
+            over_bytes = None
+            checked = []
+            stand_down = False
+            for seq in sorted(loader.batch_sizes, reverse=True):
+                b = loader.batch_sizes[seq]
+                if compile_fn is not None:
+                    compiled = compile_fn(self, seq, b)
+                else:
+                    inputs, labels = synthetic_qa_batch(b, seq)
+                    compiled = self._jit_train_step.lower(
+                        self.params, self.opt_state,
+                        self._global_batch(
+                            self._split_micro(inputs), leading_accum=True
+                        ),
+                        self._global_batch(
+                            self._split_micro(labels), leading_accum=True
+                        ),
+                        self.global_step,
+                    ).compile()
+                try:
+                    analysis = compiled.memory_analysis()
+                except Exception as e:  # noqa: BLE001 - analysis is best-effort
+                    logger.info("HBM pre-flight: memory_analysis unavailable "
+                                "(%s); skipping.", e)
+                    stand_down = True
+                    break
+                need = _preflight_bytes(analysis)
+                if need is None:
+                    logger.info(
+                        "HBM pre-flight: memory analysis unavailable; skipping."
+                    )
+                    stand_down = True
+                    break
+                checked.append({"bucket": f"{b}x{seq}", "bytes": int(need)})
+                if need > limit:
+                    over_bytes = int(need)
+                    break
+            report["buckets"] = checked
+            if stand_down or over_bytes is None:
+                break
+            new_split = self._next_batch_split()
+            if new_split is None:
+                logger.warning(
+                    "HBM pre-flight: bucket %s needs %.2f GB vs %.2f GB "
+                    "device HBM and batch_split %d cannot be raised further; "
+                    "proceeding — XLA will decide.",
+                    checked[-1]["bucket"], over_bytes / 1e9, limit / 1e9,
+                    self.batch_split,
+                )
+                break
+            logger.warning(
+                "HBM pre-flight: bucket %s at batch_split %d needs %.2f GB "
+                "vs %.2f GB device HBM; raising batch_split to %d and "
+                "re-deriving bucket batches.",
+                checked[-1]["bucket"], self.batch_split, over_bytes / 1e9,
+                limit / 1e9, new_split,
+            )
+            self.batch_split = new_split
+            report["batch_split"] = new_split
+            report["applied"] = True
+            loader.rescale(new_split * max(data_size, 1))
+            # the step closed over the old batch_split — rebuild
+            self._jit_train_step = None
         self.preflight_report = report
         return report
 
@@ -761,6 +946,12 @@ class Trainer:
 
         self.train_dataloader.set_epoch(epoch_i)
         avg_meters: dict = defaultdict(AverageMeter)
+        bucketed = isinstance(self.train_dataloader, BucketedDataLoader)
+
+        if bucketed and not self._preflight_done:
+            # per-bucket plan BEFORE any batch is drawn: may raise
+            # batch_split and re-derive the loader's bucket batch sizes
+            self.preflight_bucket_steps()
 
         iterator = self.train_dataloader
         tqdm_data = None
@@ -775,75 +966,189 @@ class Trainer:
         trace_from = (
             0 if self.debug or len(self.train_dataloader) < 5 else 2
         )
-        def consume(values, step_no: int) -> None:
+        log_every = max(1, int(self.log_every))
+        last_consumed = [None]  # last consumed step no (for the final write)
+
+        def consume(values, step_no: int, rows: int) -> None:
             # this device_get blocks until the producing step finishes — by
-            # then the NEXT step is already enqueued (see `pending` below),
+            # then the NEXT step is already enqueued (see the lag below),
             # so the device never idles on host-side metric/IO work
             host_values = jax.device_get(values)
             for k, v in host_values.items():
                 if k == "lr":
                     avg_meters["lr"] = float(v)
                 else:
-                    avg_meters[k].update(float(v))
-            self._update_writer(avg_meters, prefix="train", step=step_no)
+                    # bucketed steps carry bucket-dependent batch sizes, so
+                    # the epoch mean must weight each step's mean by its row
+                    # count to stay per-example-correct; unbucketed batches
+                    # are equal-sized (weight 1 = historical arithmetic)
+                    avg_meters[k].update(float(v), rows if bucketed else 1)
             if self.on_train_metrics is not None:
                 self.on_train_metrics(avg_meters, step=step_no)
-            if tqdm_data is not None:
-                tqdm_data.set_postfix_str(_console_str(avg_meters))
+            last_consumed[0] = step_no
+            # writer + progress-bar IO throttled to every `log_every` steps
+            # (meters above still integrate every step); the epoch's final
+            # state is always written once more in the finally below
+            if (step_no + 1) % log_every == 0:
+                self._update_writer(avg_meters, prefix="train", step=step_no)
+                if tqdm_data is not None:
+                    tqdm_data.set_postfix_str(_console_str(avg_meters))
 
         # Metrics are consumed with a ONE-STEP lag: dispatch step N, then
         # fetch step N-1's scalars while N runs. Without this the per-step
         # device_get serializes device compute with host batch prep.
-        lag = LaggedConsumer(consume, total=len(self.train_dataloader))
-        # one watchdog frame per epoch, re-ticked per step: the deadline
-        # covers dataloader waits, step dispatch AND the lagged device_get —
-        # any of them can be the thing that hangs
-        with self._watched(f"train epoch {epoch_i}") as tick:
-            for step_i, (inputs, labels) in enumerate(iterator):
-                _fault("trainer.step")
-                tick(f"train step {self.global_step} (epoch {epoch_i})")
-                if not self._preflight_done:
-                    # first batch of the run: plan HBM before executing —
-                    # may raise batch_split and rebuild the jitted step
-                    self.preflight_train_step(inputs, labels)
-                if not trace_started and epoch_i == 1 and step_i == trace_from:
-                    jax.profiler.start_trace(str(self.trace_dir))
-                    trace_started = True
+        # (Bucketed epochs take a data-dependent number of steps <= the
+        # sampler length, so the known-total early-drain stays off there.)
+        lag = LaggedConsumer(
+            consume, total=None if bucketed else len(self.train_dataloader)
+        )
 
-                inputs = self._global_batch(self._split_micro(inputs), leading_accum=True)
-                labels = self._global_batch(self._split_micro(labels), leading_accum=True)
+        def place(batch):
+            """Host batch -> placed global arrays + row count (runs on the
+            prefetch thread when device_prefetch > 0, inline otherwise —
+            same code either way, which is what makes the trajectories
+            bit-identical)."""
+            inputs, labels, meta = self._normalize_batch(batch)
+            rows = (
+                meta.rows if meta is not None
+                else int(np.shape(next(iter(inputs.values())))[0])
+            )
+            return (
+                self._global_batch(self._split_micro(inputs), leading_accum=True),
+                self._global_batch(self._split_micro(labels), leading_accum=True),
+                rows,
+            )
 
-                self.params, self.opt_state, values = self._jit_train_step(
-                    self.params, self.opt_state, inputs, labels, self.global_step
+        step_i = [0]
+
+        def run_step(placed) -> None:
+            nonlocal trace_started, trace_stopped
+            dev_inputs, dev_labels, rows = placed
+            if not trace_started and epoch_i == 1 and step_i[0] == trace_from:
+                jax.profiler.start_trace(str(self.trace_dir))
+                trace_started = True
+
+            self.params, self.opt_state, values = self._jit_train_step(
+                self.params, self.opt_state, dev_inputs, dev_labels,
+                self.global_step,
+            )
+
+            if trace_started and not trace_stopped and step_i[0] >= trace_from + 2:
+                jax.block_until_ready(values)
+                jax.profiler.stop_trace()
+                trace_stopped = True
+                logger.info(
+                    f"Device trace (steps {trace_from}-{trace_from + 2}) "
+                    f"written to {self.trace_dir}."
                 )
 
-                if trace_started and not trace_stopped and step_i >= trace_from + 2:
-                    jax.block_until_ready(values)
+            lag.feed(values, self.global_step, rows)
+            self.global_step += 1
+            step_i[0] += 1
+            if self.watchdog is not None:
+                self.watchdog.note_progress(self.global_step)
+
+        prefetcher = None
+        # one watchdog frame per epoch, re-ticked per step: the deadline
+        # covers dataloader/prefetch waits, step dispatch AND the lagged
+        # device_get — any of them can be the thing that hangs
+        with self._watched(f"train epoch {epoch_i}") as tick:
+            try:
+                host_iter = iter(iterator)
+                interrupted = False
+                if not self._preflight_done:
+                    # first batch of the run: plan HBM before executing — may
+                    # raise batch_split and rebuild the jitted step, so it
+                    # must see UNSPLIT host arrays and must happen before the
+                    # prefetch thread bakes the old split into placed batches
+                    first = next(host_iter, None)
+                    if first is not None:
+                        _fault("trainer.step")
+                        tick(f"train step {self.global_step} (epoch {epoch_i})")
+                        inputs, labels, _ = self._normalize_batch(first)
+                        self.preflight_train_step(inputs, labels)
+                        run_step(place(first))
+                        if self.debug:
+                            interrupted = True
+                if not interrupted:
+                    if self.device_prefetch and int(self.device_prefetch) > 0:
+                        prefetcher = DevicePrefetcher(
+                            host_iter, place, depth=int(self.device_prefetch)
+                        )
+                        placed_iter = iter(prefetcher)
+                    else:
+                        placed_iter = (place(b) for b in host_iter)
+                    for placed in placed_iter:
+                        _fault("trainer.step")
+                        tick(f"train step {self.global_step} (epoch {epoch_i})")
+                        run_step(placed)
+                        if self.debug:
+                            interrupted = True
+                            break
+                if interrupted:
+                    logger.info("Training was interrupted because of debug mode.")
+            finally:
+                # drain the prefetch thread and flush the metric lag even on
+                # a mid-epoch exception/SIGTERM — without this the last
+                # steps' metrics (and the trace/writer below) are silently
+                # dropped on any non-clean epoch exit
+                close_err = None
+                if prefetcher is not None:
+                    try:
+                        prefetcher.close()
+                    except BaseException as e:  # noqa: BLE001
+                        # close() raises only on a CLEAN exit with a wedged
+                        # thread (it just warns when an exception is already
+                        # propagating) — hold it until the flushes ran
+                        close_err = e
+                lag.flush()
+
+                if trace_started and not trace_stopped:  # ended mid-capture
+                    jax.block_until_ready(self.params)
                     jax.profiler.stop_trace()
                     trace_stopped = True
-                    logger.info(
-                        f"Device trace (steps {trace_from}-{trace_from + 2}) "
-                        f"written to {self.trace_dir}."
+                    logger.info(f"Device trace written to {self.trace_dir}.")
+
+                if last_consumed[0] is not None and (
+                    (last_consumed[0] + 1) % log_every != 0
+                ):
+                    # final throttled write so the epoch always ends with
+                    # current meters on the writer/progress bar
+                    self._update_writer(
+                        avg_meters, prefix="train", step=last_consumed[0]
                     )
+                    if tqdm_data is not None:
+                        tqdm_data.set_postfix_str(_console_str(avg_meters))
 
-                lag.feed(values, self.global_step)
-                self.global_step += 1
-                if self.watchdog is not None:
-                    self.watchdog.note_progress(self.global_step)
+                if bucketed and self.train_dataloader.epoch_stats:
+                    stats = self.train_dataloader.epoch_stats
+                    logger.info(
+                        "Bucketed epoch %d: %d batches, padding waste "
+                        "%.2f%% (pad-to-max would be %.2f%%).",
+                        epoch_i, stats["batches"],
+                        stats.get("padding_waste_pct", 0.0),
+                        stats.get("padmax_waste_pct", 0.0),
+                    )
+                    estimate = len(self.train_dataloader)
+                    if epoch_i == 1 and stats["batches"] < 0.8 * estimate:
+                        # the LR schedule total was sized from the pad-to-max
+                        # UPPER BOUND (steps per epoch are length-dependent
+                        # and unknowable before the data is read) — surface
+                        # how far off it was so a short run is a visible
+                        # decision, not a silent half-finished decay
+                        logger.warning(
+                            "Bucketed epoch took %d steps vs the %d-step "
+                            "schedule estimate: the LR decay will end ~%.0f%% "
+                            "early (warmup stretched accordingly). Consider "
+                            "raising n_epochs or lowering warmup_coef.",
+                            stats["batches"], estimate,
+                            100.0 * (1.0 - stats["batches"] / estimate),
+                        )
 
-                if self.debug:
-                    logger.info("Training was interrupted because of debug mode.")
-                    break
-
-            lag.flush()
-
-        if trace_started and not trace_stopped:  # epoch ended mid-capture
-            jax.block_until_ready(self.params)
-            jax.profiler.stop_trace()
-            logger.info(f"Device trace written to {self.trace_dir}.")
-
-        if self.writer is not None:
-            self.writer.flush()  # survive preemption with events intact
+                if self.writer is not None:
+                    self.writer.flush()  # survive preemption with events intact
+                if close_err is not None:
+                    raise close_err
 
     # -- test loop (trainer.py:302-353) ----------------------------------------
 
@@ -866,20 +1171,26 @@ class Trainer:
             self._jit_eval_step = self._build_eval_step()
 
         avg_meters: dict = defaultdict(AverageMeter)
+        bucketed = isinstance(self.test_dataloader, BucketedDataLoader)
 
-        iterator = enumerate(self.test_dataloader)
+        iterator = self.test_dataloader
         tqdm_data = None
         if tqdm is not None:
             tqdm_data = tqdm(
                 self.test_dataloader, desc=f"Test (epoch #{epoch_i} / {self.n_epochs})"
             )
-            iterator = enumerate(tqdm_data)
+            iterator = tqdm_data
 
-        def consume(i, labels, dev_labels, preds, values) -> None:
+        def consume(i, labels, dev_labels, preds, values, meta) -> None:
             # blocks on batch i's results — batch i+1 is already enqueued
             # (same one-step-lag pipelining as the train loop)
-            n_valid = self._test_sampler.valid_count(i)
-            is_partial = n_valid < self._test_sampler.global_batch_size
+            if meta is not None:  # bucketed batch carries its own row count
+                n_valid = meta.real_rows
+                batch_rows = meta.rows
+            else:
+                n_valid = self.test_dataloader.real_rows(i)
+                batch_rows = self._test_sampler.global_batch_size
+            is_partial = n_valid < batch_rows
 
             host_preds = host_labels = None
             if callbacks is not None or is_partial:
@@ -903,7 +1214,10 @@ class Trainer:
 
             host_values = jax.device_get(values_)
             for k, v in host_values.items():
-                avg_meters[k].update(float(v))
+                # weight by REAL rows: pad_last repetition rows carry zero
+                # weight, and bucketed batches of different sizes contribute
+                # per-example-correctly to the epoch mean
+                avg_meters[k].update(float(v), n_valid)
 
             if callbacks is not None:
                 for callback in callbacks:
@@ -912,23 +1226,60 @@ class Trainer:
             if tqdm_data is not None:
                 tqdm_data.set_postfix_str(_console_str(avg_meters))
 
-        lag = LaggedConsumer(consume, total=len(self.test_dataloader))
+        # bucketed epochs take a data-dependent number of batches, so the
+        # known-total early drain stays off there (flush() covers the tail)
+        lag = LaggedConsumer(
+            consume, total=None if bucketed else len(self.test_dataloader)
+        )
+
+        def place_eval(batch):
+            """Host batch -> (host labels, placed inputs/labels, meta); runs
+            on the prefetch thread when device_prefetch > 0."""
+            inputs, labels, meta = self._normalize_batch(batch)
+            return (
+                labels,
+                self._global_batch(inputs),
+                self._global_batch(labels),
+                meta,
+            )
+
+        prefetcher = None
+        if self.device_prefetch and int(self.device_prefetch) > 0:
+            prefetcher = DevicePrefetcher(
+                iter(iterator), place_eval, depth=int(self.device_prefetch),
+                name="device-prefetch-eval",
+            )
+            placed_iter = iter(prefetcher)
+        else:
+            placed_iter = (place_eval(b) for b in iterator)
+
         with self._watched(f"test epoch {epoch_i}") as tick:
-            for i, (inputs, labels) in iterator:
-                _fault("trainer.eval_step")
-                tick(f"eval step {i} (epoch {epoch_i})")
-                dev_inputs = self._global_batch(inputs)
-                dev_labels = self._global_batch(labels)
+            try:
+                for i, (labels, dev_inputs, dev_labels, meta) in enumerate(placed_iter):
+                    _fault("trainer.eval_step")
+                    tick(f"eval step {i} (epoch {epoch_i})")
 
-                preds, values = self._jit_eval_step(self.params, dev_inputs, dev_labels)
+                    preds, values = self._jit_eval_step(self.params, dev_inputs, dev_labels)
 
-                lag.feed(i, labels, dev_labels, preds, values)
+                    lag.feed(i, labels, dev_labels, preds, values, meta)
 
-                if self.debug and i >= 10:
-                    logger.info("Test was interrupted because of debug mode.")
-                    break
-
-            lag.flush()
+                    if self.debug and i >= 10:
+                        logger.info("Test was interrupted because of debug mode.")
+                        break
+            finally:
+                # same mid-epoch guarantees as _train: drain the prefetch
+                # thread and flush the metric lag even on exception/SIGTERM
+                # (close() raises only on a clean exit with a wedged thread;
+                # hold that until the in-flight batches have been consumed)
+                close_err = None
+                if prefetcher is not None:
+                    try:
+                        prefetcher.close()
+                    except BaseException as e:  # noqa: BLE001
+                        close_err = e
+                lag.flush()
+                if close_err is not None:
+                    raise close_err
 
         if callbacks is not None:
             for callback in callbacks:

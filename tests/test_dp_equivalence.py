@@ -119,6 +119,30 @@ def test_sp_ring_mesh_matches_single_device(tmp_path):
     _assert_same_trajectory(_run(sp), _run(single), params_atol=5e-5)
 
 
+def test_bucketed_path_bit_matches_unbucketed_on_equal_lengths(tmp_path):
+    """ISSUE 4 acceptance: on equal-length data (every DummyDataset item is
+    exactly MAX_SEQ_LEN tokens) a single-bucket grid reproduces the
+    unbucketed path's batches EXACTLY — same epoch ordering, same shapes,
+    same compiled program — so the loss trajectory and final params must be
+    bit-identical, not merely close."""
+    from test_trainer import MAX_SEQ_LEN
+
+    bucketed, _ = _make_trainer(tmp_path, mesh_spec="data:8", dropout=0.0,
+                                n_epochs=2, length_buckets=[MAX_SEQ_LEN])
+    plain, _ = _make_trainer(tmp_path, mesh_spec="data:8", dropout=0.0,
+                             n_epochs=2)
+    losses_b, params_b = _run(bucketed)
+    losses_p, params_p = _run(plain)
+    assert len(losses_b) == len(losses_p) >= 4
+    assert losses_b == losses_p, "bucketed loss trajectory not bit-identical"
+    for x, y in zip(
+        jax.tree_util.tree_leaves(params_b), jax.tree_util.tree_leaves(params_p)
+    ):
+        np.testing.assert_array_equal(
+            x, y, err_msg="bucketed final params not bit-identical"
+        )
+
+
 def test_sp_ring_seq_shard_invariant_with_dropout(tmp_path):
     """Stochastic variant: ring's in-flight dropout streams are keyed by
     GLOBAL row/col indices (seq-shard-count invariant, op-level pinned in

@@ -179,6 +179,19 @@ def test_average_meter():
     assert m() == 2.0
 
 
+def test_average_meter_weighted():
+    """Weighted updates make the running mean per-SAMPLE-correct when batch
+    means cover unequal row counts (bucketed batches, trimmed eval tails)."""
+    m = AverageMeter()
+    m.update(1.0, 8)
+    m.update(5.0, 2)
+    assert m() == pytest.approx((8 * 1.0 + 2 * 5.0) / 10)
+    # zero/negative weights are ignored, not divide-by-zero
+    m2 = AverageMeter()
+    m2.update(3.0, 0)
+    assert m2() == 0.0 and m2._counter == 0
+
+
 def test_accuracy():
     assert accuracy_score([1, 2, 3], [1, 2, 0]) == pytest.approx(2 / 3)
 

@@ -137,6 +137,59 @@ def test_predictor_random_model_runs(corpus_setup):
         assert cand.start_id <= cand.end_id
 
 
+def test_predictor_length_buckets_match_padmax_scores(corpus_setup):
+    """ISSUE-4: offline eval rides the same length buckets — every chunk is
+    scored once, in a bucket-sized batch padded to its bucket seq, and the
+    per-chunk answerability scores must match the pad-to-max path (pad
+    positions are masked, so narrower padding cannot change the math beyond
+    fp reduction noise)."""
+    tok, val_dataset, _ = corpus_setup
+    model, params = _tiny_model(tok)
+
+    def run(buckets):
+        p = Predictor(
+            model, params,
+            mesh=build_mesh("data:1"),
+            collate_fun=init_collate_fun(tok, max_seq_len=64, return_items=True),
+            batch_size=8, n_jobs=2, length_buckets=buckets,
+        )
+        p(val_dataset, save_dump=True)
+        scores = {}
+        for s, _st, _en, _lab, items in p.dump:
+            for i, it in enumerate(items):
+                scores[(it.item_id, it.chunk_start)] = float(s[i])
+        n_chunks = sum(len(d[-1]) for d in p.dump)
+        return scores, n_chunks
+
+    pad_scores, pad_chunks = run(None)
+    bkt_scores, bkt_chunks = run([32, 64])
+    # same chunks scored exactly once on both paths
+    assert bkt_chunks == pad_chunks
+    assert set(bkt_scores) == set(pad_scores)
+    for key, want in pad_scores.items():
+        np.testing.assert_allclose(
+            bkt_scores[key], want, rtol=1e-4, atol=1e-5,
+            err_msg=f"bucketed score diverged for chunk {key}",
+        )
+
+
+def test_predictor_bucketed_candidates_match_stub(corpus_setup):
+    """Bucketed candidate bookkeeping: the deterministic stub model must
+    produce the same winning spans through the bucketed batcher."""
+    tok, val_dataset, _ = corpus_setup
+    predictor = Predictor(
+        StubSpanModel(), {},
+        mesh=build_mesh("data:1"),
+        collate_fun=init_collate_fun(tok, max_seq_len=64, return_items=True),
+        batch_size=8, n_jobs=2, length_buckets=[32, 64],
+    )
+    predictor(val_dataset)
+    assert len(predictor.candidates) >= 1
+    for doc_id, cand in predictor.candidates.items():
+        assert cand.start_id == 10 and cand.end_id == 12
+        assert cand.label == 2
+
+
 def test_predictor_partial_batch_padding(corpus_setup):
     """batch_size larger than the total chunk count exercises the pad+trim."""
     tok, val_dataset, _ = corpus_setup

@@ -857,3 +857,214 @@ def test_hbm_preflight_disabled_or_no_limit(tmp_path):
     # CPU devices report no bytes_limit -> planner stands down
     assert trainer2.preflight_train_step(None, None) is None
     assert trainer2.batch_split == 1 and trainer2._preflight_done
+
+
+# -- padding-free input pipeline (ISSUE 4) ------------------------------------
+
+
+def test_bucketed_training_runs_and_updates_params(tmp_path):
+    """Bucketed path end-to-end on the 8-device mesh: params update, steps
+    land, and the loader's padding accounting is populated."""
+    trainer, _ = _make_trainer(tmp_path, length_buckets=[24, MAX_SEQ_LEN])
+    before = _param_snapshot(trainer.params)
+    trainer.train()
+    after = _param_snapshot(trainer.params)
+    assert trainer.global_step > 0
+    changed = jax.tree_util.tree_map(
+        lambda a, b: not np.allclose(a, b), before, after
+    )
+    assert any(jax.tree_util.tree_leaves(changed)), "params did not update"
+    stats = trainer.train_dataloader.epoch_stats
+    assert stats and stats["batches"] == trainer.global_step
+
+
+def test_flag_off_exactly_reproduces_default_path(tmp_path):
+    """Acceptance: --length_buckets off / --device_prefetch 0 construct the
+    plain DataLoader + synchronous placement and produce a bit-identical
+    trajectory to a default-constructed trainer."""
+    from ml_recipe_tpu.data.loader import DataLoader
+
+    (tmp_path / "off").mkdir()
+    t_off, _ = _make_trainer(
+        tmp_path / "off", length_buckets=None, device_prefetch=0
+    )
+    assert isinstance(t_off.train_dataloader, DataLoader)
+    (tmp_path / "default").mkdir()
+    t_def, _ = _make_trainer(tmp_path / "default")
+    t_off.train()
+    t_def.train()
+    for x, y in zip(
+        jax.tree_util.tree_leaves(_param_snapshot(t_off.params)),
+        jax.tree_util.tree_leaves(_param_snapshot(t_def.params)),
+    ):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_pad_last_rows_excluded_from_eval_metrics(tmp_path):
+    """Regression (ISSUE 4 satellite): pad_last repetition rows of the final
+    partial eval batch must be excluded from loss/metric averaging — the
+    meter average must equal the mean over TRIMMED per-batch losses."""
+    # 10 test items / batch 8 -> final batch has 2 real + 6 repeated rows
+    trainer, _ = _make_trainer(tmp_path, dropout=0.0, test_len=10)
+    assert trainer.test_dataloader.real_rows(0) == 8
+    assert trainer.test_dataloader.real_rows(1) == 2
+
+    metrics = trainer.test(0)
+
+    # independent recompute: eval each padded batch, trim to real_rows,
+    # and average per-batch losses weighted by REAL rows (pad rows carry
+    # zero weight in the epoch mean)
+    eval_step = trainer._build_eval_step()
+    losses, weights = [], []
+    with trainer.mesh:
+        for i, (inputs, labels) in enumerate(trainer.test_dataloader):
+            preds, _ = eval_step(
+                trainer.params,
+                trainer._global_batch(inputs),
+                trainer._global_batch(labels),
+            )
+            n = trainer.test_dataloader.real_rows(i)
+            preds = {k: jnp.asarray(np.asarray(v)[:n]) for k, v in preds.items()}
+            labels = {k: jnp.asarray(np.asarray(v)[:n]) for k, v in labels.items()}
+            _, values = trainer.loss(preds, labels)
+            losses.append(float(values["loss"]))
+            weights.append(n)
+    assert weights == [8, 2]
+    np.testing.assert_allclose(
+        metrics["loss"], np.average(losses, weights=weights), rtol=1e-5
+    )
+    # sanity that the pad rows WOULD have moved the number (the recompute is
+    # not vacuous): an untrimmed average differs
+    assert trainer._test_sampler.pad_last
+
+
+def test_bucketed_eval_trims_padded_tail_rows(tmp_path):
+    """Bucketed eval: BucketedBatch.real_rows drives the same trimming —
+    metrics must match a pad-to-max eval of the same model/data within fp
+    tolerance (different batch shapes -> different reduction order)."""
+    (tmp_path / "b").mkdir()
+    t_b, _ = _make_trainer(
+        tmp_path / "b", dropout=0.0, test_len=10,
+        length_buckets=[MAX_SEQ_LEN],
+    )
+    (tmp_path / "p").mkdir()
+    t_p, _ = _make_trainer(tmp_path / "p", dropout=0.0, test_len=10)
+    m_b = t_b.test(0)
+    m_p = t_p.test(0)
+    for k in m_p:
+        np.testing.assert_allclose(
+            float(m_b[k]), float(m_p[k]), rtol=1e-4, atol=1e-6,
+            err_msg=f"bucketed eval metric {k} diverged",
+        )
+
+
+def _fake_bucket_compile_fn(compiles, *, byte_table):
+    """memory_analysis double for the per-bucket pre-flight: bytes looked up
+    by (seq, batch_split)."""
+
+    class _Analysis:
+        def __init__(self, bytes_):
+            self.argument_size_in_bytes = bytes_
+            self.output_size_in_bytes = 0
+            self.temp_size_in_bytes = 0
+            self.alias_size_in_bytes = 0
+
+    class _Compiled:
+        def __init__(self, bytes_):
+            self._b = bytes_
+
+        def memory_analysis(self):
+            return _Analysis(self._b)
+
+    def compile_fn(trainer, seq, batch):
+        compiles.append((seq, batch, trainer.batch_split))
+        return _Compiled(byte_table[(seq, trainer.batch_split)])
+
+    return compile_fn
+
+
+def test_bucket_preflight_raises_split_and_rescales_loader(tmp_path):
+    """Per-bucket HBM pre-flight: an over-limit bucket raises batch_split
+    and RE-DERIVES every bucket's batch size before re-checking — mirroring
+    QAEngine's per-bucket warmup pre-flight on the train side."""
+    trainer, _ = _make_trainer(
+        tmp_path, batch_split=1, length_buckets=[24, MAX_SEQ_LEN]
+    )
+    loader = trainer.train_dataloader
+    sizes_before = dict(loader.batch_sizes)
+    compiles = []
+    # at split 1 the 48-bucket is over the 5k limit; at split 2 all fit
+    byte_table = {
+        (MAX_SEQ_LEN, 1): 9_000, (24, 1): 4_000,
+        (MAX_SEQ_LEN, 2): 5_000, (24, 2): 2_500,
+    }
+    report = trainer.preflight_bucket_steps(
+        compile_fn=_fake_bucket_compile_fn(compiles, byte_table=byte_table),
+        limit_bytes=5_000,
+    )
+    assert trainer.batch_split == 2
+    assert report["applied"] is True
+    assert report["batch_split_before"] == 1 and report["batch_split"] == 2
+    # checked largest seq first, re-planned once at the raised split
+    assert [c[0] for c in compiles] == [MAX_SEQ_LEN, MAX_SEQ_LEN, 24]
+    # the loader's bucket batches were re-derived for the new multiple
+    assert loader.batch_multiple == 2 * 8  # batch_split * data axis
+    assert loader.batch_sizes != sizes_before or all(
+        v % 16 == 0 for v in loader.batch_sizes.values()
+    )
+    assert all(v % 16 == 0 for v in loader.batch_sizes.values())
+    assert trainer._preflight_done
+
+
+def test_bucket_preflight_noop_within_limit(tmp_path):
+    trainer, _ = _make_trainer(
+        tmp_path, batch_split=1, length_buckets=[24, MAX_SEQ_LEN]
+    )
+    compiles = []
+    byte_table = {(MAX_SEQ_LEN, 1): 4_000, (24, 1): 2_000}
+    report = trainer.preflight_bucket_steps(
+        compile_fn=_fake_bucket_compile_fn(compiles, byte_table=byte_table),
+        limit_bytes=5_000,
+    )
+    assert trainer.batch_split == 1 and report["applied"] is False
+    assert len(compiles) == 2  # one compile per bucket, no re-plan
+    assert len(report["buckets"]) == 2
+
+
+def test_bucket_preflight_skips_off_bucket_or_no_limit(tmp_path):
+    # not bucketed -> no-op even with a limit
+    t_plain, _ = _make_trainer(tmp_path, batch_split=1)
+    assert t_plain.preflight_bucket_steps(limit_bytes=1) is None
+    # bucketed on CPU (no limit) -> stands down cleanly
+    (tmp_path / "b").mkdir()
+    t_b, _ = _make_trainer(
+        tmp_path / "b", batch_split=1, length_buckets=[MAX_SEQ_LEN]
+    )
+    assert t_b.preflight_bucket_steps() is None
+    assert t_b._preflight_done
+
+
+def test_log_every_throttles_writer_updates(tmp_path):
+    """The writer/tqdm cadence is throttled to every log_every steps (plus
+    one final write), while meters and on_train_metrics see every step."""
+    writes = []
+    steps_seen = []
+
+    class SpyWriter:
+        def add_scalar(self, tag, value, global_step=None):
+            writes.append((tag, global_step))
+
+        def flush(self):
+            pass
+
+    trainer, _ = _make_trainer(
+        tmp_path, train_len=64, log_every=3,
+        on_train_metrics=lambda meters, step: steps_seen.append(step),
+    )
+    trainer.writer = SpyWriter()
+    trainer.train()
+    assert trainer.global_step == 4
+    assert steps_seen == [0, 1, 2, 3]  # the tap still fires every step
+    # writes at step 2 ((2+1) % 3 == 0) and the final write at step 3
+    write_steps = sorted({s for _, s in writes})
+    assert write_steps == [2, 3]
