@@ -164,6 +164,8 @@ def _run_worker(params, model_params, watchdog) -> None:
         length_buckets=parse_length_buckets(
             getattr(params, "length_buckets", None), params.max_seq_len
         ),
+        sequence_packing=getattr(params, "sequence_packing", False),
+        pack_max_segments=getattr(params, "pack_max_segments", 8),
         device_prefetch=getattr(params, "device_prefetch", 0),
         log_every=getattr(params, "log_every", 10),
     )

@@ -52,6 +52,8 @@ def main(params, model_params):
         length_buckets=parse_length_buckets(
             getattr(params, "length_buckets", None), params.max_seq_len
         ),
+        sequence_packing=getattr(params, "sequence_packing", False),
+        pack_max_segments=getattr(params, "pack_max_segments", 8),
     )
 
     predictor(val_dataset)

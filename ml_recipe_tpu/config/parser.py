@@ -48,6 +48,15 @@ def _str2bool(value: str) -> bool:
     return str(value).strip().lower() in ("1", "true", "yes", "on")
 
 
+def cast_prefetch(value):
+    """Device-prefetch depth domain: an int depth, or 'auto' (measure the
+    first few step times and pick depth 1 vs 2, data/device_prefetch.py +
+    trainer.resolve_prefetch_auto)."""
+    if str(value).strip().lower() == "auto":
+        return "auto"
+    return int(value)
+
+
 def cast_loss_scale(value: str):
     """'None' -> None, 'dynamic' -> 'dynamic', anything else -> float
     (mirrors apex's loss_scale flag domain)."""
@@ -409,14 +418,30 @@ def get_trainer_parser() -> ConfigArgumentParser:
                              "size scales inversely with seq (constant "
                              "token budget per step); one compiled program "
                              "per occupied bucket. Single-process only.")
-    parser.add_argument("--device_prefetch", type=int, default=0,
+    parser.add_argument("--sequence_packing", type=str, default="off",
+                        help="Sequence packing (data/packing.py): "
+                             "concatenate short chunks into full "
+                             "max_seq_len rows with block-diagonal "
+                             "attention and per-segment heads — ~every "
+                             "token real, ONE compiled train program "
+                             "(vs one per bucket). 'off' (default) keeps "
+                             "the bucketed/padded path bit-exactly; 'on' "
+                             "enables it and supersedes --length_buckets. "
+                             "Single-process only.")
+    parser.add_argument("--pack_max_segments", type=int, default=8,
+                        help="Sequence packing: max chunks packed into one "
+                             "row (the static S of the per-segment label "
+                             "planes and head outputs).")
+    parser.add_argument("--device_prefetch", type=cast_prefetch, default=0,
                         help="Double-buffered device prefetch depth: keep "
                              "this many placed global batches in flight on "
                              "a background thread so the host->device copy "
                              "of step k+1 overlaps compute of step k. 0 = "
                              "synchronous placement (historical behavior); "
-                             "2 is the intended on-chip setting. The "
-                             "trajectory is bit-identical either way.")
+                             "2 is the intended on-chip setting; 'auto' "
+                             "times the first few steps of epoch 1 and "
+                             "picks depth 1 vs 2, logging the choice. The "
+                             "trajectory is bit-identical at any depth.")
     parser.add_argument("--log_every", type=int, default=10,
                         help="Steps between tqdm-postfix/TensorBoard writes "
                              "in the train loop (meters still update every "
@@ -581,6 +606,15 @@ def get_predictor_parser() -> ConfigArgumentParser:
                              "their bucket instead of max_seq_len; the "
                              "per-bucket batch size holds the token budget "
                              "batch_size * max_seq_len constant.")
+    parser.add_argument("--sequence_packing", type=str, default="off",
+                        help="Sequence packing for offline eval: chunks "
+                             "concatenate into full max_seq_len rows "
+                             "(block-diagonal attention, per-segment "
+                             "scoring with per-chunk score parity); "
+                             "supersedes --length_buckets (see the "
+                             "trainer flag).")
+    parser.add_argument("--pack_max_segments", type=int, default=8,
+                        help="Sequence packing: max chunks per packed row.")
 
     return parser
 

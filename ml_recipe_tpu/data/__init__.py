@@ -10,6 +10,13 @@ from .bucketing import (
     bucket_batch_sizes,
     parse_length_buckets,
 )
+from .packing import (
+    PackedBatch,
+    PackedDataLoader,
+    SequencePacker,
+    collate_packed,
+    parse_sequence_packing,
+)
 from .device_prefetch import DevicePrefetcher
 
 __all__ = [
@@ -32,5 +39,10 @@ __all__ = [
     "auto_seq_grid",
     "bucket_batch_sizes",
     "parse_length_buckets",
+    "PackedBatch",
+    "PackedDataLoader",
+    "SequencePacker",
+    "collate_packed",
+    "parse_sequence_packing",
     "DevicePrefetcher",
 ]

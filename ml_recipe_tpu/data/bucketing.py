@@ -226,6 +226,7 @@ class BucketedDataLoader:
         self._epoch = 0
         self._collates: Dict[int, object] = {}
         self._last_stats: Optional[dict] = None
+        self._len_cache: Dict[int, int] = {}
         self.rescale(batch_multiple)
 
     def rescale(self, batch_multiple: int) -> Dict[int, int]:
@@ -247,6 +248,36 @@ class BucketedDataLoader:
         seq), so an epoch takes at most as many steps as the pad-to-max
         path — which is what the LR schedule and progress displays use."""
         return len(self.sampler)
+
+    def planned_epoch_steps(self, epoch: int) -> int:
+        """Planned batch count of one epoch: simulate the bucketer over the
+        epoch's item lengths (the shared ``plan_scaled_count`` skeleton —
+        each unique index read once, cached; the dataset's chunk-sampling
+        RNG is shielded during the reads; corpora past
+        ``PLAN_SAMPLE_ITEMS`` simulate on the epoch ordering's prefix and
+        scale). This is what the LR schedule should size against —
+        ``len(self)`` is the pad-to-max UPPER BOUND and overshoots by ~the
+        per-bucket batch scaling (the end-of-epoch-1 trainer warning used
+        to fire on exactly that gap)."""
+        from .packing import plan_scaled_count
+
+        tail = [0]
+
+        def simulate(lengths):
+            bucketer = TokenBudgetBucketer(self.seq_grid, self.batch_sizes)
+            batches = 0
+            for length in lengths:
+                if bucketer.add(length, None) is not None:
+                    batches += 1
+            if self.pad_last:
+                tail[0] = sum(1 for _ in bucketer.flush())
+            return batches
+
+        return plan_scaled_count(
+            self.dataset, self.sampler, epoch, cache=self._len_cache,
+            n_jobs=self.n_jobs, read_retries=self.read_retries,
+            simulate=simulate,
+        ) + tail[0]
 
     def _collate_for(self, seq: int):
         collate = self._collates.get(seq)

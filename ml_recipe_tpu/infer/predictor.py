@@ -40,10 +40,16 @@ from ..data.bucketing import (
 )
 from ..data.collate import rebind_collate_seq
 from ..data.loader import ListDataloader
+from ..data.packing import (
+    DEFAULT_MAX_SEGMENTS,
+    SequencePacker,
+    collate_packed,
+    parse_sequence_packing,
+)
 from ..parallel import build_mesh, gather_to_host, make_global_array
 from ..serve.bucketing import pad_trailing_batch
 from ..utils.pipeline import LaggedConsumer
-from .score import OUT_KEYS, build_score_fn
+from .score import OUT_KEYS, build_packed_score_fn, build_score_fn
 
 logger = logging.getLogger(__name__)
 
@@ -109,6 +115,8 @@ class Predictor:
         limit: Optional[int] = None,
         fetch_every: int = 1,
         length_buckets: Optional[list] = None,
+        sequence_packing=False,
+        pack_max_segments: int = DEFAULT_MAX_SEGMENTS,
     ):
         self.model = model
         self.params = params
@@ -160,6 +168,35 @@ class Predictor:
             self._pad_id = int(tok.pad_token_id)
             self._sep_id = int(tok.sep_token_id)
             self._is_bert = getattr(tok, "model_name", "bert") == "bert"
+
+        # Sequence packing (data/packing.py): chunks CONCATENATE into full
+        # max_seq_len rows with block-diagonal attention — one compiled
+        # forward at one shape, ~every token real. Each chunk is scored
+        # once per segment with chunk-relative spans and its own [CLS]
+        # anchor (infer/score.build_packed_score_fn), so per-chunk scores
+        # pin to the pad-to-max path's. Supersedes length_buckets.
+        self._packing = parse_sequence_packing(sequence_packing)
+        self._pack_max_segments = max(1, int(pack_max_segments))
+        if self._packing:
+            kw = getattr(self.collate_fun, "keywords", {}) or {}
+            if kw.get("tokenizer") is None:
+                raise ValueError(
+                    "sequence_packing needs a tokenizer-bound collate_fun "
+                    "(init_collate_fun)"
+                )
+            if kw.get("max_seq_len") is None:
+                # fail HERE, not with a bare TypeError on the transfer
+                # thread mid-stream: packing needs the static row length
+                raise ValueError(
+                    "sequence_packing needs the collate's static "
+                    "max_seq_len (init_collate_fun(..., max_seq_len=...))"
+                )
+            if length_buckets:
+                logger.info(
+                    "sequence_packing supersedes length_buckets for "
+                    "offline eval (packed rows are already ~pad-free)."
+                )
+                length_buckets = None
 
         # Length-bucketed chunk batching (data/bucketing.py): chunks pad to
         # the smallest bucket seq that fits them instead of the collate's
@@ -215,6 +252,8 @@ class Predictor:
     def _build_fwd(self):
         # the scoring forward is shared with serve/engine.py (one packed
         # [6, B] fetch per batch; see infer/score.py for the wire formats)
+        if self._packing:
+            return jax.jit(build_packed_score_fn(self.model))
         if self._wire_ids_only:
             fwd = build_score_fn(
                 self.model, wire_ids_only=True, pad_id=self._pad_id,
@@ -264,12 +303,13 @@ class Predictor:
             self._jit_fwd = self._build_fwd()
 
         bucketed = self._seq_grid is not None
+        packing = self._packing
         async_dataset = ListDataloader(
             dataset,
             batch_size=self.batch_size,
             n_jobs=self.n_jobs,
-            # bucketed: stream RAW chunk lists and collate per bucket below
-            collate_fun=None if bucketed else self.collate_fun,
+            # bucketed/packed: stream RAW chunk lists and collate below
+            collate_fun=None if (bucketed or packing) else self.collate_fun,
             buffer_size=self.buffer_size,
             shuffle=True,
         )
@@ -286,9 +326,21 @@ class Predictor:
             )
 
         def process(packed, n_valid, items) -> None:
-            out = {
-                k: packed[i, :n_valid] for i, k in enumerate(self._OUT_KEYS)
-            }
+            if packing:
+                # [6, R, S] per-segment outputs -> per-chunk vectors through
+                # the packing map (row-major segment order over the mask);
+                # ``n_valid`` is the host-side [R, S] segment_mask
+                m = np.asarray(n_valid).reshape(-1) > 0
+                out = {
+                    k: packed[i].reshape(-1)[m]
+                    for i, k in enumerate(self._OUT_KEYS)
+                }
+                assert len(items) == int(m.sum()), (len(items), int(m.sum()))
+            else:
+                out = {
+                    k: packed[i, :n_valid]
+                    for i, k in enumerate(self._OUT_KEYS)
+                }
 
             self._update_candidates(out, items)
 
@@ -316,10 +368,12 @@ class Predictor:
         import jax.numpy as jnp
 
         # Bucketed batches have per-bucket shapes, so the grouped fetch's
-        # jnp.stack cannot apply — fetch per batch there.
+        # jnp.stack cannot apply — fetch per batch there. Packed batches
+        # fetch per batch too (the [6, R, S] output must pair with its own
+        # host-side segment mask).
         group_n = (
             self.fetch_every
-            if jax.process_count() == 1 and not bucketed
+            if jax.process_count() == 1 and not bucketed and not packing
             else 1
         )
 
@@ -359,7 +413,55 @@ class Predictor:
             smallest bucket seq that fits, each bucket collates at ITS seq
             when its (token-budget-scaled) batch fills, and the per-bucket
             tails flush padded with ``real`` counts — same trim discipline.
+            Packed path: chunks first-fit into full max_seq_len rows
+            (data/packing.SequencePacker); ``inputs`` becomes the
+            ``((planes, segment_starts))`` pair of the packed wire,
+            ``n_valid`` the host [rows, S] segment_mask, ``items`` the
+            flattened chunks in row-major segment order (the packing map).
             """
+            if packing:
+                tok = self.collate_fun.keywords["tokenizer"]
+                max_len = int(self.collate_fun.keywords["max_seq_len"])
+                packer = SequencePacker(
+                    max_len, max_segments=self._pack_max_segments
+                )
+                pending: list = []
+
+                def packed_batch(rows):
+                    real = len(rows)
+                    rows = rows + [rows[-1]] * (self.batch_size - real)
+                    inputs, seg_mask = collate_packed(
+                        rows, tok, max_seq_len=max_len,
+                        max_segments=self._pack_max_segments,
+                        with_labels=False,
+                    )
+                    if real < len(rows):
+                        seg_mask[real:] = 0  # pad rows: no phantom chunks
+                    planes = np.stack([
+                        inputs["input_ids"],
+                        inputs["token_type_ids"],
+                        inputs["segment_ids"],
+                        inputs["position_ids"],
+                    ])
+                    items_flat = [it for row in rows[:real] for it in row]
+                    return (
+                        (planes, inputs["segment_starts"]),
+                        seg_mask, items_flat,
+                    )
+
+                for group in iterator:  # raw chunk lists
+                    for chunk in group:
+                        pending.extend(
+                            packer.add(chunk, len(chunk.input_ids))
+                        )
+                        while len(pending) >= self.batch_size:
+                            yield packed_batch(pending[: self.batch_size])
+                            del pending[: self.batch_size]
+                pending.extend(packer.flush())
+                while pending:
+                    yield packed_batch(pending[: self.batch_size])
+                    del pending[: self.batch_size]
+                return
             if not bucketed:
                 for inputs, labels, items in iterator:
                     n_valid = len(items)
@@ -395,7 +497,13 @@ class Predictor:
         def transfer_worker() -> None:
             try:
                 for batch_i, (inputs, n_valid, items) in enumerate(host_batches()):
-                    if self._wire_ids_only:
+                    if packing:
+                        planes, starts = inputs
+                        dev_inputs = (
+                            make_global_array(planes, self.mesh, batch_axis=1),
+                            make_global_array(starts, self.mesh),
+                        )
+                    elif self._wire_ids_only:
                         packed = np.asarray(
                             inputs["input_ids"], np.uint16
                         )
@@ -444,7 +552,10 @@ class Predictor:
                     if isinstance(got, BaseException):
                         raise got
                     dev_inputs, n_valid, items = got
-                    dev_out = self._jit_fwd(self.params, dev_inputs)
+                    if isinstance(dev_inputs, tuple):  # packed wire
+                        dev_out = self._jit_fwd(self.params, *dev_inputs)
+                    else:
+                        dev_out = self._jit_fwd(self.params, dev_inputs)
                     lag.feed(dev_out, n_valid, items)
                 lag.flush()
             finally:

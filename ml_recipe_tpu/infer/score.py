@@ -38,6 +38,78 @@ OUT_KEYS = ("scores", "start_ids", "end_ids", "start_regs", "end_regs",
             "labels")
 
 
+def build_packed_score_fn(model) -> Callable:
+    """The sequence-packing twin of :func:`build_score_fn`: one forward
+    scores EVERY chunk packed into the batch's rows.
+
+    ``f(params, planes, segment_starts)`` where ``planes`` is ``[4, R, L]``
+    int32 (input_ids / token_type_ids / segment_ids / position_ids — the
+    attention mask is ``segment_ids > 0``, derived in-jit) and
+    ``segment_starts`` is ``[R, S]`` int32. Output is ``[6, R, S]`` f32 in
+    ``OUT_KEYS`` row order, per SEGMENT:
+
+    - span ids are CHUNK-RELATIVE (row argmax minus the segment's start
+      offset), so candidate validity rules (``start >= question_len + 2``)
+      apply unchanged;
+    - the answerability score's [CLS] anchor is each segment's OWN start
+      row (``start[:, s, seg_start]``) — for a single full-length segment
+      this is exactly the unpacked ``start[:, 0]``.
+
+    Absent segments produce garbage entries the caller drops through the
+    host-side ``segment_mask`` (the packing map).
+    """
+
+    def score_fn(params, planes, segment_starts):
+        import jax.numpy as jnp
+
+        ids, tt, seg, pos = planes[0], planes[1], planes[2], planes[3]
+        preds = model.apply(
+            {"params": params},
+            input_ids=ids,
+            attention_mask=(seg > 0).astype(jnp.int32),
+            token_type_ids=tt,
+            position_ids=pos,
+            segment_ids=seg,
+            segment_starts=segment_starts,
+            deterministic=True,
+        )
+
+        start = preds["start_class"]  # [R, S, L], off-segment tokens -inf'd
+        end = preds["end_class"]
+
+        start_logits = jnp.max(start, axis=-1)            # [R, S]
+        start_ids = jnp.argmax(start, axis=-1) - segment_starts
+        end_logits = jnp.max(end, axis=-1)
+        end_ids = jnp.argmax(end, axis=-1) - segment_starts
+
+        cls_probas = jax.nn.softmax(preds["cls"], axis=-1)
+        cls_ids = jnp.argmax(cls_probas, axis=-1)          # [R, S]
+
+        # answerability score, arXiv 1901.08634, anchored at each
+        # segment's own [CLS] row
+        cls_start = jnp.take_along_axis(
+            start, segment_starts[..., None], axis=-1
+        )[..., 0]
+        cls_end = jnp.take_along_axis(
+            end, segment_starts[..., None], axis=-1
+        )[..., 0]
+        scores = start_logits + end_logits - (cls_start + cls_end)
+
+        fields = {
+            "scores": scores,
+            "start_ids": start_ids,
+            "end_ids": end_ids,
+            "start_regs": preds["start_reg"],
+            "end_regs": preds["end_reg"],
+            "labels": cls_ids,
+        }
+        return jnp.stack(
+            [fields[k].astype(jnp.float32) for k in OUT_KEYS], axis=0
+        )
+
+    return score_fn
+
+
 def build_score_fn(
     model,
     *,

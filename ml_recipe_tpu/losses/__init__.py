@@ -4,7 +4,9 @@ from .losses import (
     binary_focal_loss,
     focal_loss,
     mse_loss,
+    masked_mse_loss,
     WeightedLoss,
+    PackedWeightedLoss,
     build_loss,
 )
 
@@ -14,6 +16,8 @@ __all__ = [
     "binary_focal_loss",
     "focal_loss",
     "mse_loss",
+    "masked_mse_loss",
     "WeightedLoss",
+    "PackedWeightedLoss",
     "build_loss",
 ]

@@ -67,20 +67,28 @@ def label_smoothing_loss(
     n_classes: int,
     smoothing: float = 0.0,
     ignore_index: int = -100,
+    valid: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
+    """``valid`` (optional bool [N]) restricts the mean to those rows — the
+    packed-segment path (KLDiv batchmean has no ignore_index of its own, so
+    absent segments must be masked out of the mean explicitly). ``None``
+    keeps the historical whole-batch arithmetic bit-exactly."""
     assert 0 <= smoothing <= 1
     log_probs = _log_softmax(logits)
 
     if smoothing <= 0:
+        if valid is not None:
+            targets = jnp.where(valid, targets, ignore_index)
         return cross_entropy_with_ignore(logits, targets, ignore_index=ignore_index)
 
     num_ignore = 1 + (0 <= ignore_index < n_classes)
     fill_value = smoothing / (n_classes - num_ignore)
     confidence = 1.0 - smoothing
 
+    safe_targets = targets if valid is None else jnp.where(valid, targets, 0)
     target_dist = jnp.full((targets.shape[0], n_classes), fill_value, dtype=jnp.float32)
     target_dist = jnp.asarray(target_dist).at[
-        jnp.arange(targets.shape[0]), targets
+        jnp.arange(targets.shape[0]), safe_targets
     ].set(confidence)
     if 0 <= ignore_index < n_classes:
         target_dist = target_dist.at[:, ignore_index].set(0.0)
@@ -89,7 +97,10 @@ def label_smoothing_loss(
     # averaged over the batch; 0*log(0) := 0.
     t_log_t = jnp.where(target_dist > 0, target_dist * jnp.log(target_dist), 0.0)
     kl = jnp.sum(t_log_t - target_dist * log_probs, axis=-1)
-    return jnp.mean(kl)
+    if valid is None:
+        return jnp.mean(kl)
+    v = valid.astype(jnp.float32)
+    return jnp.sum(kl * v) / jnp.maximum(jnp.sum(v), 1.0)
 
 
 def binary_focal_loss(
@@ -157,6 +168,92 @@ class WeightedLoss:
         full_loss = 0.0
         for key, (loss_f, weight) in self._losses.items():
             loss = loss_f(preds[key], targets[key])
+            values[key] = loss
+            full_loss = full_loss + weight * loss
+
+        values["loss"] = full_loss
+        return full_loss, values
+
+
+def masked_mse_loss(preds: jnp.ndarray, targets: jnp.ndarray,
+                    valid: jnp.ndarray) -> jnp.ndarray:
+    """``mse_loss`` over rows where ``valid`` only (packed-segment variant:
+    absent segments carry zero predictions/targets that must not dilute the
+    mean)."""
+    v = valid.astype(jnp.float32)
+    sq = (preds.astype(jnp.float32) - targets.astype(jnp.float32)) ** 2
+    return jnp.sum(sq * v) / jnp.maximum(jnp.sum(v), 1.0)
+
+
+class PackedWeightedLoss:
+    """``WeightedLoss`` adapter for sequence-packed batches.
+
+    Predictions arrive per SEGMENT (``[R, S, ...]`` — the packed QAModel's
+    head outputs) and targets carry a ``segment_mask`` validity plane
+    (data/packing.collate_packed). Every head is computed over the
+    flattened ``R*S`` segment axis with absent segments excluded: the span
+    and class heads reuse the base loss functions verbatim by rewriting
+    absent segments' targets to the head's ignore_index (span CE already
+    ignores -1, class CE -100, focal -1); mse and smoothing>0 — which have
+    no ignore semantics — go through the masked variants above. Returned
+    values are means over REAL segments (= original examples), so the
+    trainer's row-weighted epoch meters stay per-example-correct when
+    weighted by the batch's real segment count.
+    """
+
+    def __init__(self, base: WeightedLoss):
+        import functools as _ft
+
+        self.base = base
+        self._losses = base._losses
+        self._cls_fns = {}
+        for key, (fn, _weight) in base._losses.items():
+            if key in ("start_class", "end_class", "start_reg", "end_reg"):
+                continue
+            base_fn = fn.func if isinstance(fn, _ft.partial) else fn
+            kw = dict(fn.keywords) if isinstance(fn, _ft.partial) else {}
+            if base_fn is label_smoothing_loss:
+                self._cls_fns[key] = ("smooth", kw)
+            elif base_fn is cross_entropy_with_ignore:
+                self._cls_fns[key] = ("ignore", kw.get("ignore_index", -1))
+            elif base_fn is focal_loss:
+                self._cls_fns[key] = ("ignore", kw.get("ignore_index", -1))
+            else:
+                raise NotImplementedError(
+                    f"PackedWeightedLoss cannot adapt head {key!r} "
+                    f"({base_fn}): no ignore/mask semantics known"
+                )
+
+    @property
+    def keys(self):
+        return self.base.keys
+
+    def value_structure(self) -> dict:
+        return self.base.value_structure()
+
+    def __call__(self, preds: dict, targets: dict) -> Tuple[jnp.ndarray, dict]:
+        valid = targets["segment_mask"].reshape(-1) > 0
+
+        def flat(x):
+            x = jnp.asarray(x)
+            return x.reshape((-1,) + x.shape[2:])
+
+        values = {}
+        full_loss = 0.0
+        for key, (loss_f, weight) in self._losses.items():
+            p, t = flat(preds[key]), flat(targets[key])
+            if key in ("start_class", "end_class"):
+                # span CE ignores -1 — absent segments carry -1 already
+                # (collate) but pad ROWS repeat real labels, so re-mask
+                loss = loss_f(p, jnp.where(valid, t, -1))
+            elif key in ("start_reg", "end_reg"):
+                loss = masked_mse_loss(p, t, valid)
+            else:
+                kind, arg = self._cls_fns[key]
+                if kind == "smooth":
+                    loss = label_smoothing_loss(p, t, valid=valid, **arg)
+                else:
+                    loss = loss_f(p, jnp.where(valid, t, arg))
             values[key] = loss
             full_loss = full_loss + weight * loss
 

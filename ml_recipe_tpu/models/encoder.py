@@ -59,7 +59,8 @@ class Embeddings(nn.Module):
     ln_impl: str = "xla"
 
     @nn.compact
-    def __call__(self, input_ids, token_type_ids, *, deterministic: bool):
+    def __call__(self, input_ids, token_type_ids, *, deterministic: bool,
+                 position_ids=None):
         cfg = self.cfg
 
         word = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="word_embeddings",
@@ -70,16 +71,26 @@ class Embeddings(nn.Module):
             # fail at TRACE time (L is static) instead of letting the
             # clip-mode embedding gather silently hand every position past
             # the table its last row — a model that trains and benches fine
-            # with no positional signal beyond the table (review r5)
+            # with no positional signal beyond the table (review r5).
+            # Packed position_ids are per-segment (each < its segment
+            # length <= L), so the same L-based bound covers them.
             raise ValueError(
                 f"sequence length {L} (+offset {cfg.position_offset}) "
                 f"exceeds max_position_embeddings="
                 f"{cfg.max_position_embeddings}; widen the position table "
                 f"(--max_position_embeddings) for long-context runs"
             )
-        positions = jnp.arange(L, dtype=jnp.int32) + cfg.position_offset
-        pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
-                       name="position_embeddings", dtype=self.dtype)(positions)[None, :, :]
+        if position_ids is None:
+            positions = jnp.arange(L, dtype=jnp.int32) + cfg.position_offset
+            pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                           name="position_embeddings", dtype=self.dtype)(positions)[None, :, :]
+        else:
+            # sequence packing: positions reset to 0 at every segment
+            # boundary, so each packed chunk sees exactly the positional
+            # signal it would see unpacked
+            positions = position_ids.astype(jnp.int32) + cfg.position_offset
+            pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                           name="position_embeddings", dtype=self.dtype)(positions)
 
         if cfg.type_vocab_size > 1:
             typ = nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
@@ -104,7 +115,8 @@ class SelfAttention(nn.Module):
     ln_impl: str = "xla"
 
     @nn.compact
-    def __call__(self, hidden, mask, *, deterministic: bool):
+    def __call__(self, hidden, mask, *, deterministic: bool,
+                 segment_ids=None):
         cfg = self.cfg
         B, L, H = hidden.shape
 
@@ -125,6 +137,7 @@ class SelfAttention(nn.Module):
             dtype=self.dtype,
             impl=self.attention_impl,
             mesh=self.mesh,
+            segment_ids=segment_ids,
         )
         ctx = ctx.reshape(B, L, cfg.hidden_size)
 
@@ -156,10 +169,12 @@ class EncoderLayer(nn.Module):
     ln_impl: str = "xla"
 
     @nn.compact
-    def __call__(self, hidden, mask, deterministic: bool = True):
+    def __call__(self, hidden, mask, deterministic: bool = True,
+                 segment_ids=None):
         hidden = SelfAttention(self.cfg, self.dtype, self.attention_impl,
                                self.mesh, self.ln_impl, name="attention")(
-                               hidden, mask, deterministic=deterministic)
+                               hidden, mask, deterministic=deterministic,
+                               segment_ids=segment_ids)
         hidden = FeedForward(self.cfg, self.dtype, self.ln_impl, name="mlp")(
             hidden, deterministic=deterministic
         )
@@ -184,6 +199,9 @@ class TransformerEncoder(nn.Module):
         token_type_ids: Optional[jnp.ndarray] = None,
         *,
         deterministic: bool = True,
+        position_ids: Optional[jnp.ndarray] = None,
+        segment_ids: Optional[jnp.ndarray] = None,
+        segment_starts: Optional[jnp.ndarray] = None,
     ):
         cfg = self.cfg
         if attention_mask is None:
@@ -192,7 +210,8 @@ class TransformerEncoder(nn.Module):
             token_type_ids = jnp.zeros_like(input_ids)
 
         hidden = Embeddings(cfg, self.dtype, self.ln_impl, name="embeddings")(
-            input_ids, token_type_ids, deterministic=deterministic
+            input_ids, token_type_ids, deterministic=deterministic,
+            position_ids=position_ids,
         )
 
         layer_cls = EncoderLayer
@@ -202,9 +221,21 @@ class TransformerEncoder(nn.Module):
         for i in range(cfg.num_layers):
             hidden = layer_cls(cfg, self.dtype, self.attention_impl, self.mesh,
                                self.ln_impl, name=f"layer_{i}")(
-                               hidden, attention_mask, deterministic)
+                               hidden, attention_mask, deterministic,
+                               segment_ids)
 
-        pooled = nn.Dense(cfg.hidden_size, name="pooler", dtype=self.dtype)(hidden[:, 0])
+        if segment_starts is None:
+            pool_src = hidden[:, 0]
+        else:
+            # sequence packing: one pooled vector PER SEGMENT, from each
+            # segment's own [CLS] row ([B, S, H]; absent segments gather
+            # row 0 and are masked downstream). The pooler params are the
+            # same Dense — a single-segment row starting at 0 reproduces
+            # the unpacked pooled output exactly.
+            pool_src = jnp.take_along_axis(
+                hidden, segment_starts[..., None].astype(jnp.int32), axis=1
+            )
+        pooled = nn.Dense(cfg.hidden_size, name="pooler", dtype=self.dtype)(pool_src)
         pooled = jnp.tanh(pooled)
 
         return hidden, pooled

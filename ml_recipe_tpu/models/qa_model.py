@@ -13,6 +13,14 @@ value. The reference pads only to the per-batch max, so stray logits on pad
 positions rarely matter there; with static ``max_seq_len`` padding they would
 dominate argmax at inference, so masking restores the reference's effective
 behaviour under fixed shapes.
+
+Sequence packing (``segment_starts`` given, with ``segment_ids`` /
+``position_ids`` from data/packing.collate_packed): the trunk runs with
+block-diagonal attention and per-segment positions, and every head becomes
+per-SEGMENT — span logits ``[B, S, L]`` (each segment's distribution
+confined to its own tokens), cls/regressors from each segment's own [CLS]
+row ``[B, S, ...]``. Parameters are identical to the unpacked path, so
+checkpoints are interchangeable between packing settings.
 """
 
 from __future__ import annotations
@@ -53,10 +61,20 @@ class QAModel(nn.Module):
         token_type_ids=None,
         *,
         deterministic: bool = True,
+        position_ids=None,
+        segment_ids=None,
+        segment_starts=None,
     ):
         cfg = self.cfg
         if attention_mask is None:
             attention_mask = jnp.ones_like(input_ids)
+        packed = segment_starts is not None
+        if packed and (segment_ids is None or position_ids is None):
+            raise ValueError(
+                "packed inputs need segment_ids AND position_ids alongside "
+                "segment_starts (data/packing.collate_packed emits all "
+                "three)"
+            )
 
         sequence_output, pooled_output = TransformerEncoder(
             cfg, self.dtype, self.attention_impl, self.remat, self.mesh,
@@ -66,6 +84,9 @@ class QAModel(nn.Module):
             attention_mask=attention_mask,
             token_type_ids=token_type_ids,
             deterministic=deterministic,
+            position_ids=position_ids,
+            segment_ids=segment_ids,
+            segment_starts=segment_starts,
         )
 
         # span start/end logits over token positions (model.py:30,54-58)
@@ -78,6 +99,26 @@ class QAModel(nn.Module):
         pad_penalty = (1 - attention_mask).astype(jnp.float32) * _MASK_NEG
         start_logits = start_logits.astype(jnp.float32) + pad_penalty
         end_logits = end_logits.astype(jnp.float32) + pad_penalty
+
+        if packed:
+            # per-SEGMENT heads: every original example inside a packed row
+            # gets its own span distribution, class logits and regressors.
+            # Outputs become [B, S, ...]; downstream (packed loss, packed
+            # score_fn) scatters them back to per-chunk results through the
+            # segment_mask. Same parameters as the unpacked path (the Dense
+            # heads act on the trailing feature dim), so checkpoints are
+            # interchangeable between packing settings.
+            S = segment_starts.shape[1]
+            # [B, S, L]: segment s's logits confined to its own tokens
+            seg_eq = (
+                segment_ids[:, None, :]
+                == (1 + jnp.arange(S, dtype=segment_ids.dtype))[None, :, None]
+            )
+            seg_penalty = jnp.where(seg_eq, 0.0, jnp.float32(_MASK_NEG))
+            start_logits = start_logits[:, None, :] + seg_penalty
+            end_logits = end_logits[:, None, :] + seg_penalty
+            # pooled_output is already [B, S, H]: the encoder gathered each
+            # segment's [CLS] row through its pooler (encoder.py)
 
         # 5-class answer-type classification on pooled output (model.py:33-34,61)
         cls_hidden = nn.Dropout(cfg.hidden_dropout_prob)(

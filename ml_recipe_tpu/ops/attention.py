@@ -36,6 +36,7 @@ def _xla_attention(
     dropout_rate: float = 0.0,
     dropout_rng=None,
     dtype=jnp.float32,
+    segment_ids: Optional[jnp.ndarray] = None,  # [B, L] 0=pad, 1..S packed
 ) -> jnp.ndarray:
     depth = q.shape[-1]
     scale = 1.0 / jnp.sqrt(depth).astype(dtype)
@@ -43,8 +44,17 @@ def _xla_attention(
     # [B, H, Lq, Lk]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
 
-    if mask is not None:
-        big_neg = jnp.finfo(jnp.float32).min
+    big_neg = jnp.finfo(jnp.float32).min
+    if segment_ids is not None:
+        # block-diagonal attention for packed sequences: a query attends
+        # only keys of its OWN segment (and pad keys — seg 0 — never attend
+        # or get attended: seg 0 rows produce garbage that downstream
+        # masking ignores, the same contract as pad rows today)
+        allowed = (
+            segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        ) & (segment_ids[:, None, None, :] > 0)
+        scores = jnp.where(allowed, scores, big_neg)
+    elif mask is not None:
         scores = jnp.where(mask[:, None, None, :] > 0, scores, big_neg)
 
     # softmax in f32 for numerical stability regardless of compute dtype
@@ -79,12 +89,26 @@ def dot_product_attention(
     dtype=jnp.float32,
     impl: str = "auto",
     mesh=None,
+    segment_ids: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Multi-head attention over [B, L, H, D] tensors with a [B, L] key mask.
 
     ``impl='ring'`` runs sequence-parallel ring attention over the mesh
     ``seq`` axis (requires ``mesh``; composes with the ``data`` axis).
+
+    ``segment_ids`` ([B, L] int32, 0 = pad, 1..S = packed segment) switches
+    every implementation to the BLOCK-DIAGONAL mask of sequence packing:
+    query i attends key j iff ``seg[i] == seg[j] != 0``. The ids array
+    subsumes the key-validity mask (``seg > 0``), so ``mask`` is ignored
+    when it is given. Not supported by ``impl='ring'`` (packing targets the
+    short-chunk regime; ring is the long-context one).
     """
+    if segment_ids is not None and impl == "ring":
+        raise ValueError(
+            "segment_ids (sequence packing) is not supported by ring "
+            "attention; packed rows are single-chip shapes — use "
+            "impl='auto'/'pallas'/'xla'"
+        )
     if impl == "ring":
         from ..parallel.sharding import DATA_AXIS, SEQ_AXIS
         from .ring_attention import ring_attention
@@ -122,16 +146,22 @@ def dot_product_attention(
         # with the execution selection and double-probe.
         # Dropout needs BOTH kernel directions feasible: the forward's
         # in-kernel mask cannot be reproduced by an XLA fallback backward.
-        mask_dtype = mask.dtype if mask is not None else jnp.int32
+        # Sequence packing reuses the mask operand as the segment-id plane
+        # (0 = pad), so the kernel mask is segment_ids when packing is on.
+        segmented = segment_ids is not None
+        kernel_mask = segment_ids if segmented else mask
+        mask_dtype = kernel_mask.dtype if kernel_mask is not None else jnp.int32
         blocked_ok = supports_blocked_fwd(
             L, H, D, in_isz, out_isz, dropout_rate,
             in_dtype=q.dtype, out_dtype=dtype, mask_dtype=mask_dtype,
+            segmented=segmented,
         ) and (
             dropout_rate == 0.0
             or supports_blocked_bwd(L, H, D, in_isz, dropout_rate,
                                     out_itemsize=out_isz,
                                     in_dtype=q.dtype, out_dtype=dtype,
-                                    mask_dtype=mask_dtype)
+                                    mask_dtype=mask_dtype,
+                                    segmented=segmented)
         )
         resident_ok = supports_fused_bwd(L) or blocked_ok
         # The streaming-KV regime serves lengths the resident-KV kernels
@@ -141,6 +171,7 @@ def dot_product_attention(
         streaming_ok = not resident_ok and supports_streaming(
             L, H, D, in_isz, out_isz, dropout_rate,
             in_dtype=q.dtype, out_dtype=dtype, mask_dtype=mask_dtype,
+            segmented=segmented,
         )
         shapes_ok = resident_ok or streaming_ok
 
@@ -163,14 +194,17 @@ def dot_product_attention(
                 from .flash_streaming import streaming_attention
 
                 return streaming_attention(
-                    q, k, v, mask, seed=seed, dtype=dtype, rate=dropout_rate
+                    q, k, v, kernel_mask, seed=seed, dtype=dtype,
+                    rate=dropout_rate, segmented=segmented,
                 )
             from .flash_attention import flash_attention
 
             return flash_attention(
-                q, k, v, mask, seed=seed, dtype=dtype, rate=dropout_rate
+                q, k, v, kernel_mask, seed=seed, dtype=dtype,
+                rate=dropout_rate, segmented=segmented,
             )
 
     return _xla_attention(
-        q, k, v, mask, dropout_rate=dropout_rate, dropout_rng=dropout_rng, dtype=dtype
+        q, k, v, mask, dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+        dtype=dtype, segment_ids=segment_ids,
     )

@@ -97,13 +97,30 @@ def hash_uniform(x):
     return u24.astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
 
 
-def _softmax_probs(q, k, mask, scale):
+def _allowed_grid(qmask, kmask, seg: bool):
+    """[q_rows, k_rows] bool attend-permission grid from the mask operand.
+
+    Unsegmented (``seg=False``): the historical key-only validity — every
+    query row sees every valid key (``kmask > 0``). Segmented: the mask
+    operand carries SEGMENT IDS (0 = pad, 1..S = packed segment) and the
+    grid becomes block-diagonal — query i attends key j iff their ids match
+    and are nonzero. Pad queries (id 0) match no valid key, so their rows
+    softmax over all -inf and produce finite garbage that downstream
+    masking ignores (the exact contract pad rows already have)."""
+    if seg:
+        return (qmask[:, None] == kmask[None, :]) & (kmask[None, :] > 0)
+    return kmask[None, :] > 0
+
+
+def _softmax_probs(q, k, mask, scale, *, allowed=None):
     """[L, L] f32 attention probabilities for one (batch, head)."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
     s = s * scale
-    s = jnp.where(mask[None, :] > 0, s, _NEG_INF)
+    if allowed is None:
+        allowed = mask[None, :] > 0
+    s = jnp.where(allowed, s, _NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     return p / jnp.sum(p, axis=-1, keepdims=True)
@@ -111,7 +128,7 @@ def _softmax_probs(q, k, mask, scale):
 
 def _fused_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, o_ref,
                       *lse_ref, scale: float, rate: float, hc: int,
-                      D: int):
+                      D: int, seg: bool = False):
     """One (batch, head-group) program: softmax(q k^T / sqrt(d)) v for ``hc``
     heads, with optional attention-probs dropout, fully in VMEM. Operands
     arrive FOLDED as [B, L, H*D] — contiguous with the encoder's natural
@@ -132,6 +149,7 @@ def _fused_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, o_ref,
     the bert-large OOM the former [B, H, L, 1] layout caused)."""
     b, hj = pl.program_id(0), pl.program_id(1)
     mask = mask_ref[0, 0, :]
+    allowed = _allowed_grid(mask, mask, seg)
     for h in range(hc):
         sl = slice(h * D, (h + 1) * D)
         q = q_ref[0, :, sl]
@@ -142,7 +160,7 @@ def _fused_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, o_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        s = jnp.where(mask[None, :] > 0, s, _NEG_INF)
+        s = jnp.where(allowed, s, _NEG_INF)
         m = jnp.max(s, axis=-1, keepdims=True)
         e = jnp.exp(s - m)
         l = jnp.sum(e, axis=-1, keepdims=True)
@@ -166,7 +184,7 @@ def _fused_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _attention_bwd_math(q, k, v, g, mask, scale, *, drop=None, lse=None,
-                        out=None):
+                        out=None, allowed=None):
     """Exact softmax-attention backward for one head, probabilities
     recomputed in VMEM. ``q``/``g`` may be a q-block; ``k``/``v`` are the
     full rows. ``drop``: optional ``(keep_bool_grid, inv_rate)`` applying
@@ -179,16 +197,32 @@ def _attention_bwd_math(q, k, v, g, mask, scale, *, drop=None, lse=None,
     full [q_rows, L] ``sum(dp * p)`` pass; the identity holds WITH dropout
     (sum_j keep*inv*dp_drop * p = sum_j dp_drop * p_drop = g.out — same
     derivation as ring_attention.py's backward).
+    ``allowed``: optional [q_rows, L] bool attend-permission grid (the
+    segment-aware block-diagonal mask); None keeps the key-only 1-D mask.
     Returns ``(dq, dk, dv)`` in f32, where dk/dv have k's row count."""
     if lse is not None:
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        s = jnp.where(mask[None, :] > 0, s, _NEG_INF)
+        s = jnp.where(
+            allowed if allowed is not None else mask[None, :] > 0,
+            s, _NEG_INF,
+        )
         p = jnp.exp(s - lse)  # [q_rows, L] f32, pre-dropout
+        if allowed is not None:
+            # a segmented row can be ALL-masked (a pad query row): its lse
+            # is then -1e30 itself and exp(s - lse) degenerates to 1 on the
+            # very keys the mask forbids, leaking pad-row garbage into real
+            # dk/dv. Zero disallowed entries explicitly — for healthy rows
+            # exp(-1e30 - lse) is already 0, so this only cleans the
+            # degenerate ones (their dq/dk/dv contributions become exactly
+            # zero instead of garbage).
+            p = jnp.where(allowed, p, 0.0)
     else:
-        p = _softmax_probs(q, k, mask, scale)
+        p = _softmax_probs(q, k, mask, scale, allowed=allowed)
+        if allowed is not None:
+            p = jnp.where(allowed, p, 0.0)
     if drop is not None:
         keep, inv = drop
         p_drop = jnp.where(keep, p * inv, 0.0)
@@ -232,7 +266,7 @@ def _attention_bwd_math(q, k, v, g, mask, scale, *, drop=None, lse=None,
 def _fused_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
                       out_ref, lse_ref, dq_ref, dk_ref, dv_ref,
                       *, scale: float, rate: float, hc: int,
-                      D: int):
+                      D: int, seg: bool = False):
     """One (batch, head-group) program: exact attention backward for ``hc``
     heads, recomputing the probabilities from the forward's saved per-row
     logsumexp (and regenerating the identical dropout mask) in VMEM; the
@@ -241,6 +275,7 @@ def _fused_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
     Folded [B, L, H*D] layout like the forward."""
     b, hj = pl.program_id(0), pl.program_id(1)
     mask = mask_ref[0, 0, :]
+    allowed = _allowed_grid(mask, mask, seg) if seg else None
     for h in range(hc):
         sl = slice(h * D, (h + 1) * D)
         q = q_ref[0, :, sl]
@@ -259,7 +294,7 @@ def _fused_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
         dq, dk, dv = _attention_bwd_math(
             q, k, v, g, mask, scale, drop=drop,
             lse=lse_ref[0, 0, 0, h * rows:(h + 1) * rows][:, None],
-            out=out_ref[0, :, sl],
+            out=out_ref[0, :, sl], allowed=allowed,
         )
 
         dq_ref[0, :, sl] = dq.astype(dq_ref.dtype)
@@ -270,7 +305,7 @@ def _fused_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
 def _blocked_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
                         out_ref, lse_ref, dq_ref, dk_ref, dv_ref,
                         *, scale: float, rate: float, hc: int,
-                        D: int):
+                        D: int, seg: bool = False):
     """Fused long-sequence backward: one (batch, head-group, q-block)
     program. The whole K/V for the head group stays resident in VMEM; each
     program recomputes its q rows' EXACT probabilities from the forward's
@@ -285,6 +320,12 @@ def _blocked_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
     mask = mask_ref[0, 0, :]
     L = k_ref.shape[1]
     q_blk = q_ref.shape[1]
+    allowed = None
+    if seg:
+        # the mask block is the WHOLE row (its index map is constant in qi),
+        # so this q-block's segment ids are a dynamic slice of it
+        qmask = mask_ref[0, 0, pl.ds(qi * q_blk, q_blk)]
+        allowed = _allowed_grid(qmask, mask, seg)
     for h in range(hc):
         sl = slice(h * D, (h + 1) * D)
 
@@ -304,6 +345,7 @@ def _blocked_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
             mask, scale, drop=drop,
             lse=lse_ref[0, 0, 0, h * q_blk:(h + 1) * q_blk][:, None],
             out=out_ref[0, :, sl],  # [q_blk, D]
+            allowed=allowed,
         )
 
         dq_ref[0, :, sl] = dq.astype(dq_ref.dtype)
@@ -321,7 +363,7 @@ def _blocked_bwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
 
 def _blocked_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, o_ref,
                         *lse_ref, scale: float, rate: float, hc: int,
-                        D: int):
+                        D: int, seg: bool = False):
     """One (batch, head-group, q-block) program for longer sequences, with
     optional in-kernel attention-probs dropout (keep-bits keyed by the
     absolute row index so the backward regenerates the same mask). A
@@ -332,6 +374,11 @@ def _blocked_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, o_ref,
     mask = mask_ref[0, 0, :]
     L = k_ref.shape[1]
     q_blk = q_ref.shape[1]
+    if seg:
+        qmask = mask_ref[0, 0, pl.ds(qi * q_blk, q_blk)]
+        allowed = _allowed_grid(qmask, mask, seg)
+    else:
+        allowed = _allowed_grid(mask, mask, seg)  # [1, L] broadcast
     for h in range(hc):
         sl = slice(h * D, (h + 1) * D)
         q = q_ref[0, :, sl]
@@ -341,7 +388,7 @@ def _blocked_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, o_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        s = jnp.where(mask[None, :] > 0, s, _NEG_INF)
+        s = jnp.where(allowed, s, _NEG_INF)
         m = jnp.max(s, axis=-1, keepdims=True)
         e = jnp.exp(s - m)
         l = jnp.sum(e, axis=-1, keepdims=True)
@@ -546,7 +593,7 @@ def _pick_head_chunk(H: int, D: int, bytes_per_head: int,
 
 
 def _build_fused_fwd_call(B, L, H, D, in_dtype, out_dtype, rate, hc,
-                          interpret, want_lse):
+                          interpret, want_lse, seg=False):
     """The forward ``pallas_call`` for one head-chunk choice, shared by the
     execution path and the autotuner's compile probe so they cannot drift."""
     spec_lf = pl.BlockSpec((1, L, hc * D), lambda b, hj, *_: (b, 0, hj))
@@ -564,7 +611,7 @@ def _build_fused_fwd_call(B, L, H, D, in_dtype, out_dtype, rate, hc,
 
     return pl.pallas_call(
         functools.partial(_fused_fwd_kernel, scale=1.0 / (D ** 0.5),
-                          rate=rate, hc=hc, D=D),
+                          rate=rate, hc=hc, D=D, seg=seg),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B, H // hc),
@@ -580,7 +627,7 @@ def _build_fused_fwd_call(B, L, H, D, in_dtype, out_dtype, rate, hc,
 
 
 def _fused_fwd_analytic_hc(L, H, D, in_itemsize, out_itemsize,
-                           want_lse) -> int:
+                           want_lse, seg=False) -> int:
     """The pre-autotuner arithmetic pick for the fused forward (kept as the
     autotuner's ranking prior and its no-probe fallback)."""
     return _pick_head_chunk(
@@ -590,19 +637,30 @@ def _fused_fwd_analytic_hc(L, H, D, in_itemsize, out_itemsize,
         # double-buffered: exactly 2*8*L*4 bytes per head
         bytes_per_head=2 * L * D * (3 * in_itemsize + out_itemsize)
         + (2 * _sublane8(1) * L * 4 if want_lse else 0),
-        temp_bytes=3 * L * L * 4,  # scores/probs/dropout-uniform f32
+        # scores/probs/dropout-uniform f32, + the [L, L] block-diagonal
+        # permission grid when segment-aware
+        temp_bytes=(3 + (1 if seg else 0)) * L * L * 4,
     )
 
 
+def _seg_extra(mask_dtype, seg: bool) -> str:
+    """Autotune key suffix: segment-aware kernels are DIFFERENT programs
+    (block-diagonal mask grid) — their cached geometry must not collide
+    with the key-mask variants'."""
+    base = f"mask{jnp.dtype(mask_dtype)}"
+    return base + ("-seg" if seg else "")
+
+
 def _fused_fwd_hc(B, L, H, D, in_dtype, mask_dtype, out_dtype, rate,
-                  want_lse, interpret) -> int:
+                  want_lse, interpret, seg=False) -> int:
     """Head-chunk selection for the fused forward, through the autotuner:
     probe-validated on TPU, the old arithmetic elsewhere."""
     in_isz = jnp.dtype(in_dtype).itemsize
     out_isz = jnp.dtype(out_dtype).itemsize
 
     def analytic():
-        return _fused_fwd_analytic_hc(L, H, D, in_isz, out_isz, want_lse)
+        return _fused_fwd_analytic_hc(L, H, D, in_isz, out_isz, want_lse,
+                                      seg=seg)
 
     def cost(hc):
         # fewer head-groups = fewer grid programs and fewer k/v streams;
@@ -616,14 +674,15 @@ def _fused_fwd_hc(B, L, H, D, in_dtype, mask_dtype, out_dtype, rate,
             *[jax.ShapeDtypeStruct((1, L, H * D), in_dtype)] * 3,  # q k v
         ]
         call = _build_fused_fwd_call(1, L, H, D, in_dtype, out_dtype, rate,
-                                     hc, interpret=False, want_lse=want_lse)
+                                     hc, interpret=False, want_lse=want_lse,
+                                     seg=seg)
         return _probe_compiles(call, args,
                                aggressive=cost(hc) < cost(analytic()))
 
     hc = autotune.get().select(
         "fused_fwd_lse" if want_lse else "fused_fwd",
         L=L, H=H, D=D, in_dtype=jnp.dtype(in_dtype), out_dtype=out_dtype,
-        dropout=rate > 0.0, extra=f"mask{jnp.dtype(mask_dtype)}",
+        dropout=rate > 0.0, extra=_seg_extra(mask_dtype, seg),
         candidates=sorted(_legal_head_chunks(H, D), reverse=True),
         cost=cost, probe=probe, analytic=analytic, interpret=interpret,
     )
@@ -633,7 +692,7 @@ def _fused_fwd_hc(B, L, H, D, in_dtype, mask_dtype, out_dtype, rate,
 
 
 def _flash_forward(q, k, v, mask, seed, dtype, rate, interpret: bool,
-                   want_lse: bool = False):
+                   want_lse: bool = False, seg: bool = False):
     B, L, H, D = q.shape
     if want_lse and not interpret:
         # compiled-path invariant behind supports_fused_bwd's L % 128 gate
@@ -644,9 +703,9 @@ def _flash_forward(q, k, v, mask, seed, dtype, rate, interpret: bool,
             f"gate on supports_fused_bwd"
         )
     hc = _fused_fwd_hc(B, L, H, D, q.dtype, mask.dtype, jnp.dtype(dtype),
-                       rate, want_lse, interpret)
+                       rate, want_lse, interpret, seg=seg)
     res = _build_fused_fwd_call(B, L, H, D, q.dtype, dtype, rate, hc,
-                                interpret, want_lse)(
+                                interpret, want_lse, seg=seg)(
         _row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v)
     )
     if want_lse:
@@ -671,13 +730,14 @@ def _fused_bwd_bytes_per_head(L: int, D: int, itemsize: int,
 _FUSED_BWD_TEMPS = 5
 
 
-def _build_fused_bwd_call(B, L, H, D, in_dtype, rate, hc, interpret):
+def _build_fused_bwd_call(B, L, H, D, in_dtype, rate, hc, interpret,
+                          seg=False):
     """The backward ``pallas_call`` for one head-chunk choice, shared by the
     real execution path and the compile probe so they cannot drift."""
     spec_lf = pl.BlockSpec((1, L, hc * D), lambda b, hj, *_: (b, 0, hj))
     return pl.pallas_call(
         functools.partial(_fused_bwd_kernel, scale=1.0 / (D ** 0.5),
-                          rate=rate, hc=hc, D=D),
+                          rate=rate, hc=hc, D=D, seg=seg),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B, H // hc),
@@ -740,7 +800,7 @@ def _probe_compiles(call, arg_shapes, *, aggressive: bool) -> bool:
 
 
 def _fused_bwd_hc(B, L, H, D, in_dtype, mask_dtype, out_dtype, rate,
-                  interpret) -> int:
+                  interpret, seg=False) -> int:
     """Head-chunk choice for the fused backward, through the autotuner: on
     real TPU every candidate is ranked by modeled cost and validated with a
     cached compile probe (VERDICT r3 #3: feasibility must not depend on a
@@ -765,7 +825,8 @@ def _fused_bwd_hc(B, L, H, D, in_dtype, mask_dtype, out_dtype, rate,
         return _pick_head_chunk(
             H, D,
             bytes_per_head=_fused_bwd_bytes_per_head(L, D, itemsize, out_isz),
-            temp_bytes=_FUSED_BWD_TEMPS * L * L * 4,
+            # + the [L, L] block-diagonal permission grid when segment-aware
+            temp_bytes=(_FUSED_BWD_TEMPS + (1 if seg else 0)) * L * L * 4,
             budget=budget,
         )
 
@@ -790,14 +851,14 @@ def _fused_bwd_hc(B, L, H, D, in_dtype, mask_dtype, out_dtype, rate,
             jax.ShapeDtypeStruct((1, 1, 1, H * L), jnp.float32),  # lse
         ]
         call = _build_fused_bwd_call(1, L, H, D, in_dtype, rate, hc,
-                                     interpret=False)
+                                     interpret=False, seg=seg)
         return _probe_compiles(call, args,
                                aggressive=cost(hc) < cost(conservative))
 
     hc = autotune.get().select(
         "fused_bwd",
         L=L, H=H, D=D, in_dtype=jnp.dtype(in_dtype), out_dtype=out_dtype,
-        dropout=rate > 0.0, extra=f"mask{jnp.dtype(mask_dtype)}",
+        dropout=rate > 0.0, extra=_seg_extra(mask_dtype, seg),
         candidates=sorted(_legal_head_chunks(H, D), reverse=True),
         cost=cost, probe=probe, analytic=analytic, interpret=interpret,
     )
@@ -807,19 +868,20 @@ def _fused_bwd_hc(B, L, H, D, in_dtype, mask_dtype, out_dtype, rate,
 
 
 def _flash_backward(q, k, v, mask, seed, g, out, lse, dtype, rate,
-                    interpret: bool):
+                    interpret: bool, seg: bool = False):
     B, L, H, D = q.shape
     hc = _fused_bwd_hc(B, L, H, D, q.dtype, mask.dtype, out.dtype, rate,
-                       interpret)
+                       interpret, seg=seg)
     dq, dk, dv = _build_fused_bwd_call(B, L, H, D, q.dtype, rate, hc,
-                                       interpret)(
+                                       interpret, seg=seg)(
         _row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k),
         _fold(v), _fold(g), _fold(out), _lse_pack(lse, L))
     return tuple(x.reshape(B, L, H, D) for x in (dq, dk, dv))
 
 
 def _blocked_fwd_cfg(L: int, H: int, D: int, in_itemsize: int,
-                     out_itemsize: int, rate: float = 0.0):
+                     out_itemsize: int, rate: float = 0.0,
+                     seg: bool = False):
     """(q_blk, hc) for the q-blocked forward, or ``None`` when no
     configuration fits the VMEM budget (the dispatcher then routes to the
     XLA path instead of letting Mosaic OOM on hardware — interpret-mode
@@ -832,7 +894,8 @@ def _blocked_fwd_cfg(L: int, H: int, D: int, in_itemsize: int,
     q_blk = _pick_q_block(L)
     if q_blk is None:
         return None
-    n_temps = 3 + (1 if rate > 0.0 else 0)
+    # + the [q_blk, L] block-diagonal permission grid when segment-aware
+    n_temps = 3 + (1 if rate > 0.0 else 0) + (1 if seg else 0)
     while q_blk > 128 and n_temps * q_blk * L * 4 > _VMEM_BUDGET // 2:
         q_blk //= 2
     temp_bytes = n_temps * q_blk * L * 4
@@ -871,7 +934,8 @@ def _blocked_cost(L: int, H: int, D: int):
 
 
 def _blocked_fwd_geometry(L, H, D, in_dtype, out_dtype, rate,
-                          mask_dtype=jnp.int32, interpret=False):
+                          mask_dtype=jnp.int32, interpret=False,
+                          seg=False):
     """(q_blk, hc) for the q-blocked forward through the autotuner, or
     ``None`` when no configuration is legal. Probed WITH the lse wire
     output (the training superset — the analytic cfg counts it always for
@@ -880,7 +944,7 @@ def _blocked_fwd_geometry(L, H, D, in_dtype, out_dtype, rate,
     out_isz = jnp.dtype(out_dtype).itemsize
 
     def analytic():
-        return _blocked_fwd_cfg(L, H, D, in_isz, out_isz, rate)
+        return _blocked_fwd_cfg(L, H, D, in_isz, out_isz, rate, seg=seg)
 
     cost = _blocked_cost(L, H, D)
 
@@ -893,7 +957,7 @@ def _blocked_fwd_geometry(L, H, D, in_dtype, out_dtype, rate,
         ]
         call = _build_blocked_fwd_call(1, L, H, D, in_dtype, out_dtype,
                                        rate, q_blk, hc, interpret=False,
-                                       want_lse=True)
+                                       want_lse=True, seg=seg)
         ref = analytic()
         return _probe_compiles(
             call, args,
@@ -903,7 +967,7 @@ def _blocked_fwd_geometry(L, H, D, in_dtype, out_dtype, rate,
     return autotune.get().select(
         "blocked_fwd",
         L=L, H=H, D=D, in_dtype=jnp.dtype(in_dtype), out_dtype=out_dtype,
-        dropout=rate > 0.0, extra=f"mask{jnp.dtype(mask_dtype)}",
+        dropout=rate > 0.0, extra=_seg_extra(mask_dtype, seg),
         candidates=_blocked_candidates(L, H, D), cost=cost, probe=probe,
         analytic=analytic, interpret=interpret,
     )
@@ -912,7 +976,7 @@ def _blocked_fwd_geometry(L, H, D, in_dtype, out_dtype, rate,
 def supports_blocked_fwd(L: int, H: int, D: int, in_itemsize: int,
                          out_itemsize: int, rate: float = 0.0,
                          in_dtype=None, out_dtype=None,
-                         mask_dtype=jnp.int32) -> bool:
+                         mask_dtype=jnp.int32, segmented=False) -> bool:
     """True when the q-blocked forward has a feasible configuration for
     this exact shape/dtype geometry (no defaults: a bert-base answer for a
     different geometry would be silently wrong). On TPU the answer is the
@@ -920,7 +984,8 @@ def supports_blocked_fwd(L: int, H: int, D: int, in_itemsize: int,
     arithmetic, unchanged. Optional ``in_dtype``/``out_dtype``/``mask_dtype``
     refine the probe key to match the execution path's (derived from the
     itemsizes / int32 when absent) — a dispatcher answer keyed differently
-    from the execution selection could disagree with it."""
+    from the execution selection could disagree with it. ``segmented``
+    keys the block-diagonal (sequence-packing) kernel variant."""
     if L <= _FUSED_BWD_MAX_LEN:
         return False
     return _blocked_fwd_geometry(
@@ -929,11 +994,12 @@ def supports_blocked_fwd(L: int, H: int, D: int, in_itemsize: int,
         _dtype_for_itemsize(out_itemsize, out_dtype),
         rate,
         mask_dtype=mask_dtype,
+        seg=segmented,
     ) is not None
 
 
 def _build_blocked_fwd_call(B, L, H, D, in_dtype, out_dtype, rate, q_blk,
-                            hc, interpret, want_lse):
+                            hc, interpret, want_lse, seg=False):
     """The q-blocked forward ``pallas_call`` for one geometry, shared by the
     execution path and the autotuner's compile probe so they cannot drift."""
     out_specs = [
@@ -955,7 +1021,7 @@ def _build_blocked_fwd_call(B, L, H, D, in_dtype, out_dtype, rate, q_blk,
     # of re-streaming them L/q_blk times from HBM.
     return pl.pallas_call(
         functools.partial(_blocked_fwd_kernel, scale=1.0 / (D ** 0.5),
-                          rate=rate, hc=hc, D=D),
+                          rate=rate, hc=hc, D=D, seg=seg),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B, H // hc, L // q_blk),
@@ -973,10 +1039,11 @@ def _build_blocked_fwd_call(B, L, H, D, in_dtype, out_dtype, rate, q_blk,
 
 
 def _blocked_forward(q, k, v, mask, seed, q_blk, hc, dtype, rate,
-                     interpret: bool, want_lse: bool = False):
+                     interpret: bool, want_lse: bool = False,
+                     seg: bool = False):
     B, L, H, D = q.shape
     res = _build_blocked_fwd_call(B, L, H, D, q.dtype, dtype, rate, q_blk,
-                                  hc, interpret, want_lse)(
+                                  hc, interpret, want_lse, seg=seg)(
         _row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v)
     )
     if want_lse:
@@ -985,7 +1052,8 @@ def _blocked_forward(q, k, v, mask, seed, q_blk, hc, dtype, rate,
 
 
 def _blocked_bwd_cfg(L: int, H: int, D: int, in_itemsize: int,
-                     rate: float = 0.0, out_itemsize: int | None = None):
+                     rate: float = 0.0, out_itemsize: int | None = None,
+                     seg: bool = False):
     """(q_blk, hc) for the fused q-blocked backward, or ``None`` when no
     configuration fits the VMEM budget (the caller then falls back to the
     XLA-recompute backward instead of letting Mosaic OOM on hardware).
@@ -1003,7 +1071,8 @@ def _blocked_bwd_cfg(L: int, H: int, D: int, in_itemsize: int,
     q_blk0 = _pick_q_block(L)
     if q_blk0 is None:
         return None
-    n_temps = 4 + (1 if rate > 0.0 else 0)
+    # + the [q_blk, L] block-diagonal permission grid when segment-aware
+    n_temps = 4 + (1 if rate > 0.0 else 0) + (1 if seg else 0)
     while q_blk0 > 128 and n_temps * q_blk0 * L * 4 > _VMEM_BUDGET // 2:
         q_blk0 //= 2
     # outer q_blk walk: a q-block that satisfies the temp budget can still
@@ -1026,7 +1095,8 @@ def _blocked_bwd_cfg(L: int, H: int, D: int, in_itemsize: int,
 
 
 def _blocked_bwd_geometry(L, H, D, in_dtype, rate, out_dtype=None,
-                          mask_dtype=jnp.int32, interpret=False):
+                          mask_dtype=jnp.int32, interpret=False,
+                          seg=False):
     """(q_blk, hc) for the fused q-blocked backward through the autotuner,
     or ``None`` when no configuration is legal (the caller then falls back
     to the XLA-recompute backward)."""
@@ -1035,7 +1105,7 @@ def _blocked_bwd_geometry(L, H, D, in_dtype, rate, out_dtype=None,
 
     def analytic():
         return _blocked_bwd_cfg(L, H, D, in_isz, rate,
-                                out_itemsize=out_dtype.itemsize)
+                                out_itemsize=out_dtype.itemsize, seg=seg)
 
     cost = _blocked_cost(L, H, D)
 
@@ -1050,7 +1120,7 @@ def _blocked_bwd_geometry(L, H, D, in_dtype, rate, out_dtype=None,
                                  jnp.float32),               # lse wire
         ]
         call = _build_blocked_bwd_call(1, L, H, D, in_dtype, rate, q_blk,
-                                       hc, interpret=False)
+                                       hc, interpret=False, seg=seg)
         ref = analytic()
         return _probe_compiles(
             call, args,
@@ -1060,7 +1130,7 @@ def _blocked_bwd_geometry(L, H, D, in_dtype, rate, out_dtype=None,
     return autotune.get().select(
         "blocked_bwd",
         L=L, H=H, D=D, in_dtype=jnp.dtype(in_dtype), out_dtype=out_dtype,
-        dropout=rate > 0.0, extra=f"mask{jnp.dtype(mask_dtype)}",
+        dropout=rate > 0.0, extra=_seg_extra(mask_dtype, seg),
         candidates=_blocked_candidates(L, H, D), cost=cost, probe=probe,
         analytic=analytic, interpret=interpret,
     )
@@ -1070,13 +1140,14 @@ def supports_blocked_bwd(L: int, H: int, D: int, in_itemsize: int,
                          rate: float = 0.0,
                          out_itemsize: int | None = None,
                          in_dtype=None, out_dtype=None,
-                         mask_dtype=jnp.int32) -> bool:
+                         mask_dtype=jnp.int32, segmented=False) -> bool:
     """True when the fused q-blocked backward has a feasible configuration
     for this exact head geometry and input/output itemsizes (no defaults: a
     bert-base answer for a different geometry would be silently wrong). On
     TPU the answer is the autotuner's (compile-probe-validated, cached);
     elsewhere the analytic arithmetic, unchanged. The optional dtypes key
-    the probe identically to the execution path's selection."""
+    the probe identically to the execution path's selection. ``segmented``
+    keys the block-diagonal (sequence-packing) kernel variant."""
     if L <= _FUSED_BWD_MAX_LEN:
         return False
     return _blocked_bwd_geometry(
@@ -1088,11 +1159,12 @@ def supports_blocked_bwd(L: int, H: int, D: int, in_itemsize: int,
             out_dtype,
         ),
         mask_dtype=mask_dtype,
+        seg=segmented,
     ) is not None
 
 
 def _build_blocked_bwd_call(B, L, H, D, in_dtype, rate, q_blk, hc,
-                            interpret):
+                            interpret, seg=False):
     """The q-blocked backward ``pallas_call`` for one geometry, shared by
     the execution path and the autotuner's compile probe so they cannot
     drift."""
@@ -1101,7 +1173,7 @@ def _build_blocked_bwd_call(B, L, H, D, in_dtype, rate, q_blk, hc,
 
     return pl.pallas_call(
         functools.partial(_blocked_bwd_kernel, scale=1.0 / (D ** 0.5),
-                          rate=rate, hc=hc, D=D),
+                          rate=rate, hc=hc, D=D, seg=seg),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B, H // hc, L // q_blk),
@@ -1126,10 +1198,10 @@ def _build_blocked_bwd_call(B, L, H, D, in_dtype, rate, q_blk, hc,
 
 
 def _blocked_backward(q, k, v, mask, seed, g, out, lse, q_blk, hc, dtype,
-                      rate, interpret: bool):
+                      rate, interpret: bool, seg: bool = False):
     B, L, H, D = q.shape
     dq, dk, dv = _build_blocked_bwd_call(B, L, H, D, q.dtype, rate, q_blk,
-                                         hc, interpret)(
+                                         hc, interpret, seg=seg)(
         _row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v),
         _fold(g), _fold(out), _lse_pack(lse, q_blk))
     return (
@@ -1139,22 +1211,28 @@ def _blocked_backward(q, k, v, mask, seed, g, out, lse, q_blk, hc, dtype,
     )
 
 
-def _xla_reference(q, k, v, mask, dtype):
+def _xla_reference(q, k, v, mask, dtype, seg=False):
     """Einsum attention used for the long-sequence backward — the
-    dispatcher's XLA path itself, so kernel and fallback cannot drift."""
+    dispatcher's XLA path itself, so kernel and fallback cannot drift.
+    ``seg=True`` interprets ``mask`` as the segment-id plane and applies
+    the block-diagonal permission grid."""
     from .attention import _xla_attention
 
-    return _xla_attention(q, k, v, mask, dtype=dtype).astype(dtype)
+    return _xla_attention(
+        q, k, v, None if seg else mask, dtype=dtype,
+        segment_ids=mask if seg else None,
+    ).astype(dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def _flash_core(q, k, v, mask, seed, dtype, rate, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_core(q, k, v, mask, seed, dtype, rate, interpret, seg):
     B, L, H, D = q.shape
     if supports_fused_bwd(L, interpret):
-        return _flash_forward(q, k, v, mask, seed, dtype, rate, interpret)
+        return _flash_forward(q, k, v, mask, seed, dtype, rate, interpret,
+                              seg=seg)
     cfg = _blocked_fwd_geometry(
         L, H, D, q.dtype, jnp.dtype(dtype), rate, mask_dtype=mask.dtype,
-        interpret=interpret,
+        interpret=interpret, seg=seg,
     )
     if cfg is None:
         raise ValueError(
@@ -1162,10 +1240,11 @@ def _flash_core(q, k, v, mask, seed, dtype, rate, interpret):
             f"D={D} (rate={rate}); route this shape to the XLA path "
             f"(supports_blocked_fwd is the dispatcher's gate)"
         )
-    return _blocked_forward(q, k, v, mask, seed, *cfg, dtype, rate, interpret)
+    return _blocked_forward(q, k, v, mask, seed, *cfg, dtype, rate, interpret,
+                            seg=seg)
 
 
-def _fwd(q, k, v, mask, seed, dtype, rate, interpret):
+def _fwd(q, k, v, mask, seed, dtype, rate, interpret, seg):
     B, L, H, D = q.shape
     if supports_fused_bwd(L, interpret):
         # the forward also emits per-row logsumexp so the backward skips
@@ -1174,45 +1253,46 @@ def _fwd(q, k, v, mask, seed, dtype, rate, interpret):
         # alive for the output projection's weight grad, so this adds no
         # HBM-resident tensor
         out, lse = _flash_forward(
-            q, k, v, mask, seed, dtype, rate, interpret, want_lse=True
+            q, k, v, mask, seed, dtype, rate, interpret, want_lse=True,
+            seg=seg,
         )
         return out, (q, k, v, mask, seed, out, lse)
     if L > _FUSED_BWD_MAX_LEN and _blocked_bwd_geometry(
         L, H, D, q.dtype, rate, out_dtype=jnp.dtype(dtype),
-        mask_dtype=mask.dtype, interpret=interpret,
+        mask_dtype=mask.dtype, interpret=interpret, seg=seg,
     ) is not None:
         cfg = _blocked_fwd_geometry(
             L, H, D, q.dtype, jnp.dtype(dtype), rate, mask_dtype=mask.dtype,
-            interpret=interpret,
+            interpret=interpret, seg=seg,
         )
         if cfg is not None:
             out, lse = _blocked_forward(
                 q, k, v, mask, seed, *cfg, dtype, rate, interpret,
-                want_lse=True,
+                want_lse=True, seg=seg,
             )
             return out, (q, k, v, mask, seed, out, lse)
-    out = _flash_core(q, k, v, mask, seed, dtype, rate, interpret)
+    out = _flash_core(q, k, v, mask, seed, dtype, rate, interpret, seg)
     return out, (q, k, v, mask, seed, None, None)
 
 
-def _bwd(dtype, rate, interpret, residuals, g):
+def _bwd(dtype, rate, interpret, seg, residuals, g):
     q, k, v, mask, seed, out, lse = residuals
     L, H, D = q.shape[1], q.shape[2], q.shape[3]
     if supports_fused_bwd(L, interpret):
         dq, dk, dv = _flash_backward(
             q, k, v, mask, seed, g.astype(q.dtype), out, lse, dtype, rate,
-            interpret,
+            interpret, seg=seg,
         )
         return dq, dk, dv, None, None
     if L > _FUSED_BWD_MAX_LEN and lse is not None:
         cfg = _blocked_bwd_geometry(
             L, H, D, q.dtype, rate, out_dtype=jnp.dtype(dtype),
-            mask_dtype=mask.dtype, interpret=interpret,
+            mask_dtype=mask.dtype, interpret=interpret, seg=seg,
         )
         if cfg is not None:
             dq, dk, dv = _blocked_backward(
                 q, k, v, mask, seed, g.astype(q.dtype), out, lse, *cfg,
-                dtype, rate, interpret,
+                dtype, rate, interpret, seg=seg,
             )
             return dq, dk, dv, None, None
     if rate > 0.0:
@@ -1224,7 +1304,8 @@ def _bwd(dtype, rate, interpret, residuals, g):
             f"D={D} with dropout; gate on supports_blocked_bwd"
         )
     _, vjp = jax.vjp(
-        lambda q_, k_, v_: _xla_reference(q_, k_, v_, mask, dtype), q, k, v
+        lambda q_, k_, v_: _xla_reference(q_, k_, v_, mask, dtype, seg=seg),
+        q, k, v,
     )
     dq, dk, dv = vjp(g)
     return dq, dk, dv, None, None
@@ -1234,7 +1315,7 @@ _flash_core.defvjp(_fwd, _bwd)
 
 
 def flash_attention(q, k, v, mask, seed=None, dtype=jnp.float32, rate=0.0,
-                    interpret=False):
+                    interpret=False, segmented=False):
     """Fused attention over [B, L, H, D] with a [B, L] key-validity mask.
 
     ``seed``: int32 array of shape (1,) keying the in-kernel dropout mask
@@ -1248,9 +1329,17 @@ def flash_attention(q, k, v, mask, seed=None, dtype=jnp.float32, rate=0.0,
     for shapes with no feasible kernel config (the dispatcher in
     ops/attention.py gates on the ``supports_*`` predicates and routes such
     shapes to the XLA path instead).
+
+    ``segmented=True`` switches to the sequence-packing contract: ``mask``
+    then carries per-token SEGMENT IDS (int32, 0 = pad, 1..S = packed
+    segment) and every kernel regime applies the block-diagonal permission
+    grid ``q_seg == k_seg != 0`` instead of the key-only 1-D mask; the
+    dropout hash keys by absolute (row, col) indices either way, so the
+    backward regenerates the exact forward mask.
     """
     if mask is None:
         mask = jnp.ones(q.shape[:2], dtype=jnp.int32)
     if seed is None:
         seed = jnp.zeros((1,), dtype=jnp.int32)
-    return _flash_core(q, k, v, mask, seed, dtype, rate, interpret)
+    return _flash_core(q, k, v, mask, seed, dtype, rate, interpret,
+                       bool(segmented))

@@ -45,6 +45,7 @@ from . import autotune
 from .flash_attention import (
     _NEG_INF,
     _VMEM_BUDGET,
+    _allowed_grid,
     _dtype_for_itemsize,
     _fold,
     _legal_head_chunks,
@@ -52,6 +53,7 @@ from .flash_attention import (
     _lse_unpack,
     _probe_compiles,
     _row_seeds,
+    _seg_extra,
     _sublane8,
     _uniform_grid,
 )
@@ -65,7 +67,7 @@ def _pick_stream_block(L: int):
 
 
 def streaming_cfg(L: int, H: int, D: int, in_itemsize: int,
-                  out_itemsize: int, rate: float = 0.0):
+                  out_itemsize: int, rate: float = 0.0, seg: bool = False):
     """(blk, hc) for the streaming kernels, or ``None``.
 
     Working set per program (the dk/dv kernel is the heaviest): f32
@@ -80,7 +82,8 @@ def streaming_cfg(L: int, H: int, D: int, in_itemsize: int,
     blk = _pick_stream_block(L)
     if blk is None:
         return None
-    n_tiles = 4 + (1 if rate > 0.0 else 0)
+    # + the [blk, blk] block-diagonal permission tile when segment-aware
+    n_tiles = 4 + (1 if rate > 0.0 else 0) + (1 if seg else 0)
     tile_bytes = n_tiles * blk * blk * 4
     for hc in sorted(_legal_head_chunks(H, D), reverse=True):
         lanes = hc * D
@@ -108,7 +111,7 @@ def _stream_candidates(L: int, H: int, D: int):
 
 
 def _streaming_geometry(L, H, D, in_dtype, out_dtype, rate,
-                        mask_dtype=None, interpret=False):
+                        mask_dtype=None, interpret=False, seg=False):
     """(blk, hc) for the streaming kernels through the autotuner, or
     ``None``. One geometry serves both directions, so the probe compiles
     the forward AND the heavier dk/dv backward — a candidate is legal only
@@ -120,7 +123,7 @@ def _streaming_geometry(L, H, D, in_dtype, out_dtype, rate,
     )
 
     def analytic():
-        return streaming_cfg(L, H, D, in_isz, out_isz, rate)
+        return streaming_cfg(L, H, D, in_isz, out_isz, rate, seg=seg)
 
     def cost(geom):
         blk, hc = geom
@@ -138,7 +141,7 @@ def _streaming_geometry(L, H, D, in_dtype, out_dtype, rate,
             *[jax.ShapeDtypeStruct((1, L, H * D), in_dtype)] * 3,  # q k v
         ]
         fwd = _build_stream_fwd_call(1, L, H, D, in_dtype, out_dtype, rate,
-                                     blk, hc, interpret=False)
+                                     blk, hc, interpret=False, seg=seg)
         if not _probe_compiles(fwd, fwd_args, aggressive=aggressive):
             return False
         dkv_args = [
@@ -149,13 +152,13 @@ def _streaming_geometry(L, H, D, in_dtype, out_dtype, rate,
             jax.ShapeDtypeStruct((1, L // blk, 1, H * blk), jnp.float32),
         ]
         dkv = _build_stream_dkv_call(1, L, H, D, in_dtype, rate, blk, hc,
-                                     interpret=False)
+                                     interpret=False, seg=seg)
         return _probe_compiles(dkv, dkv_args, aggressive=aggressive)
 
     return autotune.get().select(
         "stream",
         L=L, H=H, D=D, in_dtype=jnp.dtype(in_dtype), out_dtype=out_dtype,
-        dropout=rate > 0.0, extra=f"mask{mask_dtype}",
+        dropout=rate > 0.0, extra=_seg_extra(mask_dtype, seg),
         candidates=_stream_candidates(L, H, D), cost=cost, probe=probe,
         analytic=analytic, interpret=interpret,
     )
@@ -164,19 +167,21 @@ def _streaming_geometry(L, H, D, in_dtype, out_dtype, rate,
 def supports_streaming(L: int, H: int, D: int, in_itemsize: int,
                        out_itemsize: int, rate: float = 0.0,
                        in_dtype=None, out_dtype=None,
-                       mask_dtype=None) -> bool:
+                       mask_dtype=None, segmented=False) -> bool:
     """True when the streaming regime applies: a legal block geometry that
     fits VMEM — the autotuner's compile-probe-validated answer on TPU, the
     analytic arithmetic elsewhere. Both directions share one (blk, hc)
     config, so — unlike the q-blocked regime — dropout needs no second
     feasibility check. The optional dtypes key the probe identically to
-    the execution path's selection."""
+    the execution path's selection. ``segmented`` keys the block-diagonal
+    (sequence-packing) kernel variant."""
     return _streaming_geometry(
         L, H, D,
         _dtype_for_itemsize(in_itemsize, in_dtype),
         _dtype_for_itemsize(out_itemsize, out_dtype),
         rate,
         mask_dtype=mask_dtype,
+        seg=segmented,
     ) is not None
 
 
@@ -189,15 +194,30 @@ def _keep_tile(seed_ref, b, bh, L, blk, qi, ki, rate):
     return u >= rate
 
 
+def _stream_mask_tile(mask_ref, blk, qi, ki, seg: bool):
+    """The attend-permission tile of one (qi, ki) program.
+
+    Unsegmented: mask_ref is the ``(1, 1, blk)`` k-slice block and the tile
+    is the historical key-only ``[1, blk]`` broadcast row. Segmented: the
+    mask block is the WHOLE ``(1, 1, L)`` segment-id row (its index map is
+    constant in qi/ki) and both the q- and k-slices come from dynamic
+    slices of it, giving the ``[blk, blk]`` block-diagonal grid."""
+    if seg:
+        qm = mask_ref[0, 0, pl.ds(qi * blk, blk)]
+        km = mask_ref[0, 0, pl.ds(ki * blk, blk)]
+        return _allowed_grid(qm, km, True)
+    return mask_ref[0, 0, :][None, :] > 0
+
+
 def _stream_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref,
                        o_ref, lse_ref, acc_ref, m_ref, l_ref,
                        *, scale: float, rate: float, hc: int, D: int,
-                       L: int):
+                       L: int, seg: bool = False):
     b, hj, qi, ki = (pl.program_id(0), pl.program_id(1),
                      pl.program_id(2), pl.program_id(3))
     nk = pl.num_programs(3)
     blk = q_ref.shape[1]
-    maskb = mask_ref[0, 0, :]                      # [blk] k-slice
+    allowed = _stream_mask_tile(mask_ref, blk, qi, ki, seg)
     first = ki == 0
     for h in range(hc):
         sl = slice(h * D, (h + 1) * D)
@@ -209,7 +229,7 @@ def _stream_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        s = jnp.where(maskb[None, :] > 0, s, _NEG_INF)
+        s = jnp.where(allowed, s, _NEG_INF)
 
         m_old = jnp.where(first, jnp.float32(_NEG_INF), m_ref[h, :, :])
         l_old = jnp.where(first, 0.0, l_ref[h, :, :])
@@ -248,16 +268,25 @@ def _stream_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref,
             )[:, 0]  # lane row at the head-major offset (_lse_pack)
 
 
-def _stream_tile_ds(q, k, v, g, out, lse, maskb, scale, keep, rate):
+def _stream_tile_ds(q, k, v, g, out, lse, allowed, scale, keep, rate,
+                    seg: bool = False):
     """Shared [blk, blk] backward tile math: probabilities from the saved
     row lse, dropout regenerated from absolute indices, softmax row term
-    from the delta identity. Returns (p_drop, ds) in f32."""
+    from the delta identity. ``allowed`` is the attend-permission tile
+    ([1, blk] key-only broadcast or the [blk, blk] block-diagonal grid).
+    Returns (p_drop, ds) in f32."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale
-    s = jnp.where(maskb[None, :] > 0, s, _NEG_INF)
+    s = jnp.where(allowed, s, _NEG_INF)
     p = jnp.exp(s - lse)                           # pre-dropout probs
+    if seg:
+        # an ALL-masked segmented row (pad query) has lse == -1e30 and
+        # exp(s - lse) degenerates to 1 on forbidden keys — zero them so
+        # pad-row garbage never leaks into real dk/dv (healthy rows are
+        # already 0 there; see flash_attention._attention_bwd_math)
+        p = jnp.where(allowed, p, 0.0)
     dp_drop = jax.lax.dot_general(
         g, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
@@ -279,12 +308,12 @@ def _stream_tile_ds(q, k, v, g, out, lse, maskb, scale, keep, rate):
 def _stream_dq_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
                       out_ref, lse_ref, dq_ref, dqa_ref,
                       *, scale: float, rate: float, hc: int, D: int,
-                      L: int):
+                      L: int, seg: bool = False):
     b, hj, qi, ki = (pl.program_id(0), pl.program_id(1),
                      pl.program_id(2), pl.program_id(3))
     nk = pl.num_programs(3)
     blk = q_ref.shape[1]
-    maskb = mask_ref[0, 0, :]
+    allowed = _stream_mask_tile(mask_ref, blk, qi, ki, seg)
     for h in range(hc):
         sl = slice(h * D, (h + 1) * D)
         keep = (
@@ -296,7 +325,7 @@ def _stream_dq_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
             q_ref[0, :, sl], kk, v_ref[0, :, sl],
             g_ref[0, :, sl], out_ref[0, :, sl],
             lse_ref[0, 0, 0, h * blk:(h + 1) * blk][:, None],
-            maskb, scale, keep, rate,
+            allowed, scale, keep, rate, seg=seg,
         )
         dq_acc = jnp.where(ki == 0, 0.0, dqa_ref[:, sl]) + jax.lax.dot_general(
             ds.astype(kk.dtype), kk, (((1,), (0,)), ((), ())),
@@ -312,14 +341,14 @@ def _stream_dq_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
 def _stream_dkv_kernel(seed_ref, mask_ref, k_ref, v_ref, q_ref, g_ref,
                        out_ref, lse_ref, dk_ref, dv_ref, dka_ref, dva_ref,
                        *, scale: float, rate: float, hc: int, D: int,
-                       L: int):
+                       L: int, seg: bool = False):
     # note the grid: (B, HJ, nk, nq) — q INNERMOST, so the dk/dv scratch
     # accumulates across the whole q sweep while k/v blocks stay resident
     b, hj, ki, qi = (pl.program_id(0), pl.program_id(1),
                      pl.program_id(2), pl.program_id(3))
     nq = pl.num_programs(3)
     blk = k_ref.shape[1]
-    maskb = mask_ref[0, 0, :]
+    allowed = _stream_mask_tile(mask_ref, blk, qi, ki, seg)
     for h in range(hc):
         sl = slice(h * D, (h + 1) * D)
         keep = (
@@ -332,7 +361,7 @@ def _stream_dkv_kernel(seed_ref, mask_ref, k_ref, v_ref, q_ref, g_ref,
             q, k_ref[0, :, sl], v_ref[0, :, sl], g,
             out_ref[0, :, sl],
             lse_ref[0, 0, 0, h * blk:(h + 1) * blk][:, None],
-            maskb, scale, keep, rate,
+            allowed, scale, keep, rate, seg=seg,
         )
         dv_acc = jnp.where(qi == 0, 0.0, dva_ref[:, sl]) + jax.lax.dot_general(
             p_drop.astype(g.dtype), g, (((0,), (0,)), ((), ())),
@@ -351,8 +380,20 @@ def _stream_dkv_kernel(seed_ref, mask_ref, k_ref, v_ref, q_ref, g_ref,
             dv_ref[0, :, sl] = dv_acc.astype(dv_ref.dtype)
 
 
+def _stream_mask_spec(L, blk, *, k_index, seg: bool):
+    """Mask BlockSpec of the streaming kernels: the historical ``(1, 1,
+    blk)`` k-slice, or — segment-aware — the whole ``(1, 1, L)`` id row
+    (constant index map, so Pallas keeps it resident; the kernel slices
+    both the q and k sides dynamically)."""
+    if seg:
+        return pl.BlockSpec((1, 1, L), lambda b, hj, i, j, *_: (b, 0, 0))
+    if k_index == 2:
+        return pl.BlockSpec((1, 1, blk), lambda b, hj, ki, qi, *_: (b, 0, ki))
+    return pl.BlockSpec((1, 1, blk), lambda b, hj, qi, ki, *_: (b, 0, ki))
+
+
 def _build_stream_fwd_call(B, L, H, D, in_dtype, out_dtype, rate, blk, hc,
-                           interpret):
+                           interpret, seg=False):
     """The streaming forward ``pallas_call`` for one (blk, hc), shared by
     the execution path and the autotuner's compile probe so they cannot
     drift."""
@@ -360,12 +401,12 @@ def _build_stream_fwd_call(B, L, H, D, in_dtype, out_dtype, rate, blk, hc,
     spec_k = pl.BlockSpec((1, blk, hc * D), lambda b, hj, qi, ki, *_: (b, ki, hj))
     return pl.pallas_call(
         functools.partial(_stream_fwd_kernel, scale=1.0 / (D ** 0.5),
-                          rate=rate, hc=hc, D=D, L=L),
+                          rate=rate, hc=hc, D=D, L=L, seg=seg),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B, H // hc, L // blk, L // blk),
             in_specs=[
-                pl.BlockSpec((1, 1, blk), lambda b, hj, qi, ki, *_: (b, 0, ki)),
+                _stream_mask_spec(L, blk, k_index=3, seg=seg),
                 spec_q, spec_k, spec_k,
             ],
             out_specs=[
@@ -387,17 +428,18 @@ def _build_stream_fwd_call(B, L, H, D, in_dtype, out_dtype, rate, blk, hc,
     )
 
 
-def _stream_forward(q, k, v, mask, seed, blk, hc, dtype, rate, interpret):
+def _stream_forward(q, k, v, mask, seed, blk, hc, dtype, rate, interpret,
+                    seg=False):
     B, L, H, D = q.shape
     out, lse = _build_stream_fwd_call(B, L, H, D, q.dtype, dtype, rate, blk,
-                                      hc, interpret)(
+                                      hc, interpret, seg=seg)(
         _row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v)
     )
     return out.reshape(B, L, H, D), _lse_unpack(lse, blk, H)
 
 
 def _stream_backward(q, k, v, mask, seed, g, out, lse, blk, hc, dtype, rate,
-                     interpret):
+                     interpret, seg=False):
     B, L, H, D = q.shape
     scale = 1.0 / (D ** 0.5)
     spec_q = pl.BlockSpec((1, blk, hc * D), lambda b, hj, qi, ki, *_: (b, qi, hj))
@@ -409,12 +451,12 @@ def _stream_backward(q, k, v, mask, seed, g, out, lse, blk, hc, dtype, rate,
 
     dq = pl.pallas_call(
         functools.partial(_stream_dq_kernel, scale=scale, rate=rate, hc=hc,
-                          D=D, L=L),
+                          D=D, L=L, seg=seg),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B, H // hc, L // blk, L // blk),  # (.., nq, nk): k inner
             in_specs=[
-                pl.BlockSpec((1, 1, blk), lambda b, hj, qi, ki, *_: (b, 0, ki)),
+                _stream_mask_spec(L, blk, k_index=3, seg=seg),
                 spec_q, spec_k, spec_k, spec_q, spec_q, spec_lse,
             ],
             out_specs=[spec_q],
@@ -429,13 +471,13 @@ def _stream_backward(q, k, v, mask, seed, g, out, lse, blk, hc, dtype, rate,
                 args[6], args[7])
     dk, dv = _build_stream_dkv_call(B, L, H, D, q.dtype, rate, blk, hc,
                                     interpret, k_dtype=k.dtype,
-                                    v_dtype=v.dtype)(*dkv_args)
+                                    v_dtype=v.dtype, seg=seg)(*dkv_args)
     return (dq.reshape(B, L, H, D), dk.reshape(B, L, H, D),
             dv.reshape(B, L, H, D))
 
 
 def _build_stream_dkv_call(B, L, H, D, in_dtype, rate, blk, hc, interpret,
-                           k_dtype=None, v_dtype=None):
+                           k_dtype=None, v_dtype=None, seg=False):
     """The streaming dk/dv ``pallas_call`` for one (blk, hc) — the heaviest
     of the three streaming kernels (two f32 scratch accumulators), so it is
     the one the autotuner probes alongside the forward. ``k_dtype`` /
@@ -447,12 +489,12 @@ def _build_stream_dkv_call(B, L, H, D, in_dtype, rate, blk, hc, interpret,
     spec_qq = pl.BlockSpec((1, blk, hc * D), lambda b, hj, ki, qi, *_: (b, qi, hj))
     return pl.pallas_call(
         functools.partial(_stream_dkv_kernel, scale=scale, rate=rate, hc=hc,
-                          D=D, L=L),
+                          D=D, L=L, seg=seg),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(B, H // hc, L // blk, L // blk),  # (.., nk, nq): q inner
             in_specs=[
-                pl.BlockSpec((1, 1, blk), lambda b, hj, ki, qi, *_: (b, 0, ki)),
+                _stream_mask_spec(L, blk, k_index=2, seg=seg),
                 spec_kq, spec_kq, spec_qq, spec_qq, spec_qq,
                 pl.BlockSpec((1, 1, 1, hc * blk),
                              lambda b, hj, ki, qi, *_: (b, qi, 0, hj)),
@@ -473,36 +515,38 @@ def _build_stream_dkv_call(B, L, H, D, in_dtype, rate, blk, hc, interpret,
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def _stream_core(q, k, v, mask, seed, dtype, rate, interpret):
-    out, _ = _stream_fwd(q, k, v, mask, seed, dtype, rate, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _stream_core(q, k, v, mask, seed, dtype, rate, interpret, seg):
+    out, _ = _stream_fwd(q, k, v, mask, seed, dtype, rate, interpret, seg)
     return out
 
 
-def _stream_fwd(q, k, v, mask, seed, dtype, rate, interpret):
+def _stream_fwd(q, k, v, mask, seed, dtype, rate, interpret, seg):
     B, L, H, D = q.shape
     cfg = _streaming_geometry(L, H, D, q.dtype, jnp.dtype(dtype), rate,
-                              mask_dtype=mask.dtype, interpret=interpret)
+                              mask_dtype=mask.dtype, interpret=interpret,
+                              seg=seg)
     if cfg is None:
         raise ValueError(
             f"no VMEM-feasible streaming config for L={L}, H={H}, D={D} "
             f"(rate={rate}); gate on supports_streaming"
         )
     out, lse = _stream_forward(q, k, v, mask, seed, *cfg, dtype, rate,
-                               interpret)
+                               interpret, seg=seg)
     return out, (q, k, v, mask, seed, out, lse)
 
 
-def _stream_bwd(dtype, rate, interpret, residuals, g):
+def _stream_bwd(dtype, rate, interpret, seg, residuals, g):
     q, k, v, mask, seed, out, lse = residuals
     B, L, H, D = q.shape
     # same key as the forward's selection -> the cached geometry, so both
     # directions always run the SAME (blk, hc)
     cfg = _streaming_geometry(L, H, D, q.dtype, jnp.dtype(dtype), rate,
-                              mask_dtype=mask.dtype, interpret=interpret)
+                              mask_dtype=mask.dtype, interpret=interpret,
+                              seg=seg)
     dq, dk, dv = _stream_backward(
         q, k, v, mask, seed, g.astype(q.dtype), out, lse, *cfg, dtype, rate,
-        interpret,
+        interpret, seg=seg,
     )
     return dq, dk, dv, None, None
 
@@ -511,12 +555,15 @@ _stream_core.defvjp(_stream_fwd, _stream_bwd)
 
 
 def streaming_attention(q, k, v, mask, seed=None, dtype=jnp.float32,
-                        rate=0.0, interpret=False):
+                        rate=0.0, interpret=False, segmented=False):
     """Streaming-KV attention over [B, L, H, D] with a [B, L] key mask —
     the beyond-2k regime (VMEM O(blk^2) per program, any ``L`` a stream
-    block divides). Same contract as ``flash_attention``."""
+    block divides). Same contract as ``flash_attention``, including the
+    ``segmented`` sequence-packing variant (``mask`` then carries segment
+    ids; the permission grid is block-diagonal)."""
     if mask is None:
         mask = jnp.ones(q.shape[:2], dtype=jnp.int32)
     if seed is None:
         seed = jnp.zeros((1,), dtype=jnp.int32)
-    return _stream_core(q, k, v, mask, seed, dtype, rate, interpret)
+    return _stream_core(q, k, v, mask, seed, dtype, rate, interpret,
+                        bool(segmented))

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import time
 from collections import defaultdict
 from contextlib import nullcontext
 from typing import Any, Optional
@@ -42,6 +43,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..data.bucketing import BucketedBatch, BucketedDataLoader, synthetic_qa_batch
 from ..data.device_prefetch import DevicePrefetcher
 from ..data.loader import DataLoader, ShardedBatchSampler
+from ..data.packing import (
+    DEFAULT_MAX_SEGMENTS,
+    PackedBatch,
+    PackedDataLoader,
+    parse_sequence_packing,
+)
+from ..losses import PackedWeightedLoss
 from ..metrics import AverageMeter
 from ..resilience.faults import fire as _fault
 from ..parallel import build_mesh, gather_to_host, make_global_array, shard_params
@@ -62,6 +70,28 @@ try:  # pragma: no cover - cosmetic only
     from tqdm.auto import tqdm
 except Exception:  # noqa: BLE001
     tqdm = None
+
+
+# --device_prefetch auto: steps timed synchronously at the start of epoch 1
+# before the depth decision (the first is discarded when more than one was
+# captured — it may carry compile time).
+_PREFETCH_AUTO_PROBE_STEPS = 3
+
+
+def resolve_prefetch_auto(place_s, step_s, *, threshold: float = 0.05) -> int:
+    """Depth heuristic for ``--device_prefetch auto``: depth 2 (double
+    buffering) when the host-side placement (micro-split + H2D copy) costs
+    at least ``threshold`` of the measured step wall — that is the overlap
+    double buffering actually buys — else depth 1 (still off the step path,
+    no second in-flight batch pinning HBM). Lists may be ragged/empty
+    (short epochs): defaults to 1."""
+    if not place_s or not step_s:
+        return 1
+    if len(place_s) > 1:
+        place_s, step_s = place_s[1:], step_s[1:]
+    place = sum(place_s) / len(place_s)
+    step = sum(step_s) / len(step_s)
+    return 2 if place >= threshold * max(step, 1e-9) else 1
 
 
 # The HBM byte arithmetic (device_hbm_bytes / preflight_bytes) lives in
@@ -161,13 +191,27 @@ class Trainer:
     # shapes would diverge across hosts).
     length_buckets: Any = None
 
+    # Sequence packing (data/packing.py): concatenate short chunks into one
+    # fixed (train_batch_size, max_seq_len) row layout with block-diagonal
+    # attention — ~every token real, ONE compiled train program. Off (the
+    # default) reproduces the bucketed/padded path bit-exactly (pinned in
+    # tests/test_dp_equivalence.py). Supersedes length_buckets when both
+    # are on (packing subsumes the bucketed win); single-process only, like
+    # bucketing — multi-host runs fall back with a warning.
+    sequence_packing: Any = False
+    # Per-row segment cap: the static S of the [rows, S] label planes and
+    # per-segment head outputs.
+    pack_max_segments: int = DEFAULT_MAX_SEGMENTS
+
     # Double-buffered device prefetch (data/device_prefetch.py): keep this
     # many placed global batches in flight on a background thread so the
     # host->device copy of step k+1 overlaps the compute of step k.
     # 0 = synchronous placement (exactly the historical behavior). The
     # trajectory is bit-identical either way (pinned in
-    # tests/test_device_prefetch.py).
-    device_prefetch: int = 0
+    # tests/test_device_prefetch.py). 'auto' times the first few steps of
+    # epoch 1 (synchronously) and picks depth 1 vs 2 from the share of the
+    # step the host-side placement costs, logging the choice.
+    device_prefetch: Any = 0
 
     # Throttle per-step host overhead: tqdm postfix + TensorBoard writes
     # happen every `log_every` consumed steps (and once more at epoch end)
@@ -198,7 +242,12 @@ class Trainer:
             self.n_epochs = 2
 
         # -- data loaders (trainer.py:100-114,150-181) ------------------------
-        self._seq_grid = self._resolve_seq_grid()
+        self._packing = self._resolve_packing()
+        self._seq_grid = None if self._packing else self._resolve_seq_grid()
+        if self._packing:
+            # packed batches carry per-segment labels + a segment_mask;
+            # every head's mean must run over REAL segments only
+            self.loss = PackedWeightedLoss(self.loss)
         data_size = int(
             self.mesh.shape.get("data", 1) if hasattr(self.mesh, "shape") else 1
         )
@@ -222,7 +271,22 @@ class Trainer:
                 drop_last=True,
                 seed=self.seed,
             )
-            if self._seq_grid is not None:
+            if self._packing:
+                self.train_dataloader = PackedDataLoader(
+                    self.train_dataset, self._train_sampler,
+                    self._collate_tokenizer(),
+                    max_seq_len=self._collate_max_seq_len(),
+                    rows_per_batch=self.train_batch_size,
+                    max_segments=self.pack_max_segments,
+                    n_jobs=self.n_jobs,
+                )
+                logger.info(
+                    "Sequence packing: %d rows x %d tokens per step, "
+                    "max %d segments per row (one compiled program).",
+                    self.train_batch_size, self.train_dataloader.max_seq_len,
+                    self.pack_max_segments,
+                )
+            elif self._seq_grid is not None:
                 self.train_dataloader = BucketedDataLoader(
                     self.train_dataset, self._train_sampler, self.collate_fun,
                     seq_grid=self._seq_grid,
@@ -255,7 +319,17 @@ class Trainer:
                 pad_last=True,
                 seed=self.seed,
             )
-            if self._seq_grid is not None:
+            if self._packing:
+                self.test_dataloader = PackedDataLoader(
+                    self.test_dataset, self._test_sampler,
+                    self._collate_tokenizer(),
+                    max_seq_len=self._collate_max_seq_len(),
+                    rows_per_batch=self.test_batch_size,
+                    max_segments=self.pack_max_segments,
+                    n_jobs=self.n_jobs,
+                    pad_last=True,
+                )
+            elif self._seq_grid is not None:
                 self.test_dataloader = BucketedDataLoader(
                     self.test_dataset, self._test_sampler, self.collate_fun,
                     seq_grid=self._seq_grid,
@@ -287,6 +361,7 @@ class Trainer:
         self.opt_state = None
         self.scheduler = None
         self._schedule_count = None
+        self._planned_steps_per_epoch = None
         self._zero_shardings = None
         self._use_loss_scale = False
         if self.train_dataloader is not None and self.trainer_params is not None:
@@ -302,7 +377,20 @@ class Trainer:
                     f"lower batch_split or raise train_batch_size."
                 )
 
-            steps_per_epoch = len(self.train_dataloader)
+            # LR-schedule sizing: packed/bucketed epochs take a content-
+            # dependent number of steps far below len(dataset)/batch (the
+            # packer merges several items per row; bucket batches carry
+            # more rows than the global batch). Sizing the schedule from
+            # the pad-to-max upper bound silently stretches warmup and
+            # never finishes the decay — so derive the estimate from the
+            # loader's PLANNED step count (a cheap length-only simulation
+            # of epoch 1's packing/bucketing, data/packing.py) instead.
+            self._planned_steps_per_epoch = self._plan_schedule_steps()
+            steps_per_epoch = (
+                self._planned_steps_per_epoch
+                if self._planned_steps_per_epoch is not None
+                else len(self.train_dataloader)
+            )
             num_training_steps = max(self.n_epochs * steps_per_epoch, 1)
             if self.warmup_coef > 0:
                 logger.info(
@@ -354,6 +442,7 @@ class Trainer:
             self.init_opt_state()
 
         self.global_step = 0
+        self._prefetch_choice = None  # --device_prefetch auto's decision
         self.writer = init_writer(self.is_primary, self.writer_dir)
 
         self._jit_train_step = None
@@ -404,6 +493,18 @@ class Trainer:
             logger.info("ZeRO-1: optimizer state sharded over the data axis.")
         self._bundle_ls()
 
+    def _prefetch_auto(self) -> bool:
+        return str(self.device_prefetch).strip().lower() == "auto"
+
+    def _prefetch_depth_static(self) -> int:
+        """Resolved prefetch depth for loops that do not self-measure (the
+        eval loop; train epochs after the auto decision): 0 = synchronous
+        placement. 'auto' before any measurement conservatively runs at
+        depth 1 (off the step path, no second in-flight batch)."""
+        if self._prefetch_auto():
+            return self._prefetch_choice if self._prefetch_choice else 1
+        return int(self.device_prefetch) if self.device_prefetch else 0
+
     def _watched(self, label: str, *, scale: float = 1.0):
         """Watchdog frame around a unit of host-side work, yielding a
         per-step ``tick`` (re-entrant: checkpoint barriers arm their own
@@ -432,6 +533,75 @@ class Trainer:
         (shared implementation: parallel.sharding.split_micro)."""
         return split_micro(tree, self.batch_split)
 
+    def _resolve_packing(self) -> bool:
+        """Normalize ``sequence_packing``; multi-host runs fall back to the
+        pad-to-max path with a warning (row composition is length-dependent
+        and step shapes would diverge across hosts, exactly the bucketing
+        constraint); with ``length_buckets`` also set, packing wins (it
+        subsumes the bucketed padding win) with a log line."""
+        if not parse_sequence_packing(self.sequence_packing):
+            return False
+        if self.process_count > 1:
+            logger.warning(
+                "sequence_packing: packing is single-process (length-"
+                "dependent row composition would diverge step shapes "
+                "across hosts); falling back to pad-to-max batching."
+            )
+            return False
+        if self.collate_fun is None or self._collate_tokenizer() is None:
+            logger.warning(
+                "sequence_packing needs a tokenizer-bound collate_fun "
+                "(make_collate_fun); falling back to pad-to-max batching."
+            )
+            return False
+        if self.length_buckets:
+            logger.info(
+                "sequence_packing supersedes length_buckets: packed rows "
+                "are already ~pad-free and compile ONE program (buckets "
+                "would only re-introduce per-shape programs)."
+            )
+        return True
+
+    def _collate_tokenizer(self):
+        return getattr(self.collate_fun, "keywords", {}).get("tokenizer")
+
+    def _collate_max_seq_len(self) -> int:
+        max_len = getattr(self.collate_fun, "keywords", {}).get("max_seq_len")
+        if max_len is None:
+            raise ValueError(
+                "sequence_packing needs the collate's static max_seq_len "
+                "(make_collate_fun(..., max_seq_len=...))"
+            )
+        return int(max_len)
+
+    def _plan_schedule_steps(self):
+        """Planned steps per epoch for LR-schedule sizing, from the
+        loader's length-only packing/bucketing simulation (epoch 1's plan
+        stands in for all epochs — orderings reshuffle but the length
+        population is the same). None = no planner (plain loader: the
+        historical ``len(dataloader)`` arithmetic is already exact)."""
+        loader = self.train_dataloader
+        if not hasattr(loader, "planned_epoch_steps"):
+            return None
+        try:
+            planned = int(loader.planned_epoch_steps(1))
+        except Exception as e:  # noqa: BLE001 - planning is best-effort
+            logger.warning(
+                "LR-schedule step planning failed (%s); falling back to "
+                "the len(dataloader) upper bound.", e,
+            )
+            return None
+        planned = max(planned, 1)
+        upper = len(loader)
+        if planned != upper:
+            logger.info(
+                "LR schedule sized from the planned epoch step count: %d "
+                "steps/epoch (the pad-to-max upper bound would have been "
+                "%d — a %.0f%% overshoot that would stretch warmup/decay).",
+                planned, upper, 100.0 * (upper - planned) / max(upper, 1),
+            )
+        return planned
+
     def _resolve_seq_grid(self):
         """Normalized sorted bucket grid from ``length_buckets`` (or None).
         Extended to cover the collate's static max_seq_len (an item longer
@@ -457,9 +627,10 @@ class Trainer:
     @staticmethod
     def _normalize_batch(batch):
         """Loader item -> ``(inputs, labels, meta)``; ``meta`` is the
-        BucketedBatch (bucket seq + real_rows) on the bucketed path, None on
+        BucketedBatch (bucket seq + real_rows) on the bucketed path, the
+        PackedBatch (rows + real segment count) on the packed path, None on
         the plain pad-to-max path."""
-        if isinstance(batch, BucketedBatch):
+        if isinstance(batch, (BucketedBatch, PackedBatch)):
             return batch.inputs, batch.labels, batch
         inputs, labels = batch
         return inputs, labels, None
@@ -947,6 +1118,9 @@ class Trainer:
         self.train_dataloader.set_epoch(epoch_i)
         avg_meters: dict = defaultdict(AverageMeter)
         bucketed = isinstance(self.train_dataloader, BucketedDataLoader)
+        packed = isinstance(self.train_dataloader, PackedDataLoader)
+        # variable per-step example counts: weight each step's mean by them
+        weighted = bucketed or packed
 
         if bucketed and not self._preflight_done:
             # per-bucket plan BEFORE any batch is drawn: may raise
@@ -978,11 +1152,12 @@ class Trainer:
                 if k == "lr":
                     avg_meters["lr"] = float(v)
                 else:
-                    # bucketed steps carry bucket-dependent batch sizes, so
-                    # the epoch mean must weight each step's mean by its row
-                    # count to stay per-example-correct; unbucketed batches
-                    # are equal-sized (weight 1 = historical arithmetic)
-                    avg_meters[k].update(float(v), rows if bucketed else 1)
+                    # bucketed steps carry bucket-dependent batch sizes and
+                    # packed steps row-dependent SEGMENT counts, so the
+                    # epoch mean must weight each step's mean by its example
+                    # count to stay per-example-correct; plain batches are
+                    # equal-sized (weight 1 = historical arithmetic)
+                    avg_meters[k].update(float(v), rows if weighted else 1)
             if self.on_train_metrics is not None:
                 self.on_train_metrics(avg_meters, step=step_no)
             last_consumed[0] = step_no
@@ -997,22 +1172,25 @@ class Trainer:
         # Metrics are consumed with a ONE-STEP lag: dispatch step N, then
         # fetch step N-1's scalars while N runs. Without this the per-step
         # device_get serializes device compute with host batch prep.
-        # (Bucketed epochs take a data-dependent number of steps <= the
-        # sampler length, so the known-total early-drain stays off there.)
+        # (Bucketed/packed epochs take a data-dependent number of steps <=
+        # the sampler length, so the known-total early-drain stays off.)
         lag = LaggedConsumer(
-            consume, total=None if bucketed else len(self.train_dataloader)
+            consume, total=None if weighted else len(self.train_dataloader)
         )
 
         def place(batch):
-            """Host batch -> placed global arrays + row count (runs on the
-            prefetch thread when device_prefetch > 0, inline otherwise —
+            """Host batch -> placed global arrays + example count (runs on
+            the prefetch thread when device_prefetch > 0, inline otherwise —
             same code either way, which is what makes the trajectories
-            bit-identical)."""
+            bit-identical). The count is what the meters weight by: rows
+            for plain/bucketed batches, REAL segments for packed ones."""
             inputs, labels, meta = self._normalize_batch(batch)
-            rows = (
-                meta.rows if meta is not None
-                else int(np.shape(next(iter(inputs.values())))[0])
-            )
+            if isinstance(meta, PackedBatch):
+                rows = meta.segments
+            elif meta is not None:
+                rows = meta.rows
+            else:
+                rows = int(np.shape(next(iter(inputs.values())))[0])
             return (
                 self._global_batch(self._split_micro(inputs), leading_accum=True),
                 self._global_batch(self._split_micro(labels), leading_accum=True),
@@ -1070,10 +1248,45 @@ class Trainer:
                         run_step(place(first))
                         if self.debug:
                             interrupted = True
+                if not interrupted and self._prefetch_auto() and (
+                    self._prefetch_choice is None
+                ):
+                    # --device_prefetch auto: time a few steps synchronously
+                    # (placement wall vs step wall, first sample discarded as
+                    # possibly-compiling) and pick depth 1 vs 2 for the rest
+                    # of the run
+                    place_s, step_s = [], []
+                    for _ in range(_PREFETCH_AUTO_PROBE_STEPS):
+                        b = next(host_iter, None)
+                        if b is None:
+                            break
+                        _fault("trainer.step")
+                        tick(f"train step {self.global_step} (epoch {epoch_i})")
+                        t0 = time.perf_counter()
+                        placed = place(b)
+                        t1 = time.perf_counter()
+                        run_step(placed)
+                        jax.block_until_ready(self.params)
+                        place_s.append(t1 - t0)
+                        step_s.append(time.perf_counter() - t1)
+                        if self.debug:
+                            interrupted = True
+                            break
+                    self._prefetch_choice = resolve_prefetch_auto(
+                        place_s, step_s
+                    )
+                    logger.info(
+                        "device_prefetch auto: placement %.1f ms vs step "
+                        "%.1f ms over %d probe steps -> depth %d.",
+                        1e3 * (sum(place_s) / len(place_s)) if place_s else 0,
+                        1e3 * (sum(step_s) / len(step_s)) if step_s else 0,
+                        len(place_s), self._prefetch_choice,
+                    )
                 if not interrupted:
-                    if self.device_prefetch and int(self.device_prefetch) > 0:
+                    depth = self._prefetch_depth_static()
+                    if depth > 0:
                         prefetcher = DevicePrefetcher(
-                            host_iter, place, depth=int(self.device_prefetch)
+                            host_iter, place, depth=depth
                         )
                         placed_iter = iter(prefetcher)
                     else:
@@ -1120,26 +1333,44 @@ class Trainer:
                     if tqdm_data is not None:
                         tqdm_data.set_postfix_str(_console_str(avg_meters))
 
-                if bucketed and self.train_dataloader.epoch_stats:
+                if weighted and self.train_dataloader.epoch_stats:
                     stats = self.train_dataloader.epoch_stats
-                    logger.info(
-                        "Bucketed epoch %d: %d batches, padding waste "
-                        "%.2f%% (pad-to-max would be %.2f%%).",
-                        epoch_i, stats["batches"],
-                        stats.get("padding_waste_pct", 0.0),
-                        stats.get("padmax_waste_pct", 0.0),
+                    if packed:
+                        logger.info(
+                            "Packed epoch %d: %d batches, packing "
+                            "efficiency %.2f%% (padding waste %.2f%%; "
+                            "pad-to-max would waste %.2f%%).",
+                            epoch_i, stats["batches"],
+                            100.0 * stats.get("packing_efficiency", 0.0),
+                            stats.get("padding_waste_pct", 0.0),
+                            stats.get("padmax_waste_pct", 0.0),
+                        )
+                    else:
+                        logger.info(
+                            "Bucketed epoch %d: %d batches, padding waste "
+                            "%.2f%% (pad-to-max would be %.2f%%).",
+                            epoch_i, stats["batches"],
+                            stats.get("padding_waste_pct", 0.0),
+                            stats.get("padmax_waste_pct", 0.0),
+                        )
+                    # the LR schedule is sized from the loader's PLANNED
+                    # step count (a length-only simulation of the packer/
+                    # bucketer — _plan_schedule_steps) rather than the old
+                    # len(dataset)/batch upper bound; the warning now only
+                    # fires when the ACTUAL epoch undershoots even that
+                    # plan (stochastic chunk lengths drifting, mid-epoch
+                    # abort), so it flags real schedule stretch instead of
+                    # the planner's known overshoot
+                    estimate = (
+                        self._planned_steps_per_epoch
+                        if self._planned_steps_per_epoch is not None
+                        else len(self.train_dataloader)
                     )
-                    estimate = len(self.train_dataloader)
                     if epoch_i == 1 and stats["batches"] < 0.8 * estimate:
-                        # the LR schedule total was sized from the pad-to-max
-                        # UPPER BOUND (steps per epoch are length-dependent
-                        # and unknowable before the data is read) — surface
-                        # how far off it was so a short run is a visible
-                        # decision, not a silent half-finished decay
                         logger.warning(
-                            "Bucketed epoch took %d steps vs the %d-step "
-                            "schedule estimate: the LR decay will end ~%.0f%% "
-                            "early (warmup stretched accordingly). Consider "
+                            "Epoch took %d steps vs the %d-step schedule "
+                            "estimate: the LR decay will end ~%.0f%% early "
+                            "(warmup stretched accordingly). Consider "
                             "raising n_epochs or lowering warmup_coef.",
                             stats["batches"], estimate,
                             100.0 * (1.0 - stats["batches"] / estimate),
@@ -1172,6 +1403,7 @@ class Trainer:
 
         avg_meters: dict = defaultdict(AverageMeter)
         bucketed = isinstance(self.test_dataloader, BucketedDataLoader)
+        packed = isinstance(self.test_dataloader, PackedDataLoader)
 
         iterator = self.test_dataloader
         tqdm_data = None
@@ -1184,6 +1416,42 @@ class Trainer:
         def consume(i, labels, dev_labels, preds, values, meta) -> None:
             # blocks on batch i's results — batch i+1 is already enqueued
             # (same one-step-lag pipelining as the train loop)
+            if isinstance(meta, PackedBatch):
+                # packed eval: the device loss is already a mean over REAL
+                # segments only (PackedWeightedLoss keys every head on
+                # segment_mask, and pad rows carry zero mask), so no
+                # partial-batch recompute is needed; callbacks receive the
+                # per-chunk arrays scattered out of the [rows, S] segment
+                # planes through the packing map (row-major segment order)
+                n_valid = meta.segments
+                host_values = jax.device_get(values)
+                for k, v in host_values.items():
+                    avg_meters[k].update(float(v), n_valid)
+                if callbacks is not None:
+                    host_preds = gather_to_host(preds)
+                    host_labels = (
+                        labels if self.process_count == 1
+                        else gather_to_host(dev_labels)
+                    )
+                    m = np.asarray(host_labels["segment_mask"]).reshape(-1) > 0
+                    host_preds = {
+                        k: np.asarray(v).reshape(
+                            (-1,) + np.asarray(v).shape[2:]
+                        )[m]
+                        for k, v in host_preds.items()
+                    }
+                    host_labels = {
+                        k: np.asarray(v).reshape(-1)[m]
+                        for k, v in host_labels.items()
+                        if k != "segment_mask"
+                    }
+                    for callback in callbacks:
+                        callback.at_iteration_end(
+                            host_preds, host_labels, avg_meters
+                        )
+                if tqdm_data is not None:
+                    tqdm_data.set_postfix_str(_console_str(avg_meters))
+                return
             if meta is not None:  # bucketed batch carries its own row count
                 n_valid = meta.real_rows
                 batch_rows = meta.rows
@@ -1226,10 +1494,12 @@ class Trainer:
             if tqdm_data is not None:
                 tqdm_data.set_postfix_str(_console_str(avg_meters))
 
-        # bucketed epochs take a data-dependent number of batches, so the
-        # known-total early drain stays off there (flush() covers the tail)
+        # bucketed/packed epochs take a data-dependent number of batches, so
+        # the known-total early drain stays off there (flush() covers the
+        # tail)
         lag = LaggedConsumer(
-            consume, total=None if bucketed else len(self.test_dataloader)
+            consume,
+            total=None if (bucketed or packed) else len(self.test_dataloader),
         )
 
         def place_eval(batch):
@@ -1244,9 +1514,10 @@ class Trainer:
             )
 
         prefetcher = None
-        if self.device_prefetch and int(self.device_prefetch) > 0:
+        eval_depth = self._prefetch_depth_static()
+        if eval_depth > 0:
             prefetcher = DevicePrefetcher(
-                iter(iterator), place_eval, depth=int(self.device_prefetch),
+                iter(iterator), place_eval, depth=eval_depth,
                 name="device-prefetch-eval",
             )
             placed_iter = iter(prefetcher)

@@ -269,3 +269,61 @@ def test_bench_serve_emits_closed_loop_latency_json(bench, capsys):
     assert 0 <= parsed["padding_waste_mean"] < 1
     assert parsed["buckets"] == ["4x64"]
     assert parsed["autotune_probes"] == 0
+
+
+def test_bench_input_packed_pass_pins_waste_reduction(bench, capsys):
+    """ISSUE-5 acceptance: the sequence-packed loader pass of ``bench.py
+    --mode input`` on the synthetic NQ mix (the recorded 45.7% -> 12.1%
+    corpus at its seq-512 shape) cuts the residual bucketed waste >= 5x.
+    The absolute packed waste lands at ~2.3%: the mix's quantized 463-token
+    chunks leave a 49-token hole NO chunk can fill, flooring any
+    non-splitting packer around 2% — the packer itself lands under 2% on
+    continuous NQ-like length mixes (pinned in test_packing.py). Everything
+    here is seeded, so these numbers are deterministic."""
+    import types
+
+    args = types.SimpleNamespace(
+        seq_len=512,
+        global_batch=32,
+        input_docs=384,
+        input_doc_len=1800,
+        infer_jobs=8,
+        doc_stride=256,
+        length_buckets="auto",
+        sequence_packing="on",
+        pack_max_segments=8,
+    )
+    bench.bench_input(args)
+    parsed = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # the recorded bucketed baseline (~12%) reproduces at this shape...
+    assert 10.0 < parsed["padding_waste_pct"] < 14.0
+    # ...and packing removes >= 5x of that residual waste
+    assert parsed["waste_reduction_x_packed"] >= 5.0
+    assert parsed["padding_waste_pct_packed"] < 3.0
+    assert parsed["packing_efficiency"] >= 0.97
+    assert parsed["padding_waste_pct_packed"] < parsed["padding_waste_pct"]
+    # throughput/accounting fields ride along for the driver
+    assert parsed["rows_per_sec_packed"] > 0
+    assert parsed["nonpad_tokens_per_sec_packed"] > 0
+    assert parsed["batches_packed"] >= 1
+    assert parsed["pack_max_segments"] == 8
+
+
+def test_bench_input_sequence_packing_off_skips_packed_pass(bench, capsys):
+    import types
+
+    args = types.SimpleNamespace(
+        seq_len=128,
+        global_batch=8,
+        input_docs=24,
+        input_doc_len=300,
+        infer_jobs=4,
+        doc_stride=64,
+        length_buckets="off",
+        sequence_packing="off",
+        pack_max_segments=8,
+    )
+    bench.bench_input(args)
+    parsed = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "padding_waste_pct_packed" not in parsed
+    assert "packing_efficiency" not in parsed

@@ -159,3 +159,25 @@ def test_sp_ring_seq_shard_invariant_with_dropout(tmp_path):
                              n_epochs=2, attention_impl="ring",
                              prng_impl="threefry2x32")
     _assert_same_trajectory(_run(sp), _run(small), params_atol=5e-5)
+
+
+def test_sequence_packing_off_bit_matches_head(tmp_path):
+    """ISSUE 5 acceptance: ``--sequence_packing off`` (the default) is the
+    pre-packing code path bit-exactly — a trainer constructed with the flag
+    explicitly off must produce the same trajectory, bit for bit, as one
+    that never saw the flag (guards against accidental default-on or
+    packed-code leakage into the plain path)."""
+    off, _ = _make_trainer(tmp_path, mesh_spec="data:8", dropout=0.0,
+                           n_epochs=2, sequence_packing=False)
+    default, _ = _make_trainer(tmp_path, mesh_spec="data:8", dropout=0.0,
+                               n_epochs=2)
+    losses_o, params_o = _run(off)
+    losses_d, params_d = _run(default)
+    assert len(losses_o) == len(losses_d) >= 4
+    assert losses_o == losses_d, "packing-off loss trajectory not bit-identical"
+    for x, y in zip(
+        jax.tree_util.tree_leaves(params_o), jax.tree_util.tree_leaves(params_d)
+    ):
+        np.testing.assert_array_equal(
+            x, y, err_msg="packing-off final params not bit-identical"
+        )

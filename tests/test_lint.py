@@ -42,3 +42,41 @@ def test_check_bare_except_catches_violations(tmp_path):
     )
     assert out.returncode == 1
     assert "bad.py" in out.stdout
+
+
+def test_all_parser_flags_documented_in_readme():
+    """ISSUE-5 satellite: every ``add_argument`` flag in config/parser.py
+    must appear in README.md (the subsystem sections or the generated
+    "Flag reference" table) or be explicitly allowlisted here — so a new
+    knob (like the packing flags this gate was written alongside) cannot
+    land undocumented."""
+    from ml_recipe_tpu.config.parser import (
+        get_model_parser,
+        get_predictor_parser,
+        get_serve_parser,
+        get_trainer_parser,
+    )
+
+    # deliberate exclusions only — add a flag here with a reason, or
+    # (better) document it in README
+    allowlist: set = set()
+
+    flags = set()
+    for factory in (get_model_parser, get_trainer_parser,
+                    get_predictor_parser, get_serve_parser):
+        for action in factory()._actions:
+            flags.update(
+                opt for opt in action.option_strings if opt.startswith("--")
+            )
+
+    import re
+
+    # EXACT flag tokens documented in the README — substring containment
+    # would let an undocumented `--pack` hide behind `--pack_max_segments`
+    documented = set(re.findall(r"--[A-Za-z0-9_][A-Za-z0-9_-]*",
+                                (_REPO / "README.md").read_text()))
+    missing = sorted(f for f in flags if f not in allowlist and f not in documented)
+    assert not missing, (
+        f"flags missing from README.md (document them in a section or the "
+        f"Flag reference table, or allowlist with a reason): {missing}"
+    )

@@ -558,7 +558,7 @@ def test_fused_bwd_hc_probe_halves_on_vmem_overflow(monkeypatch, tmp_path):
 
     hcs_built = []
 
-    def fake_build(B, L, H, D, in_dtype, rate, hc, interpret):
+    def fake_build(B, L, H, D, in_dtype, rate, hc, interpret, seg=False):
         hcs_built.append(hc)
         return hc
 
@@ -646,7 +646,7 @@ def test_fused_bwd_hc_unclassified_error_falls_back_to_conservative(
             return _FakeLowered(self.hc)
 
     monkeypatch.setattr(fa, "_build_fused_bwd_call",
-                        lambda B, L, H, D, d, r, hc, interpret: hc)
+                        lambda B, L, H, D, d, r, hc, interpret, seg=False: hc)
     monkeypatch.setattr(fa.jax, "jit", lambda hc: _FakeJitted(hc))
 
     hc = fa._fused_bwd_hc(4, 512, 12, 64, jnp.bfloat16, jnp.int32,

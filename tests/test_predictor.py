@@ -437,3 +437,77 @@ def test_score_fn_refactor_is_bit_identical(corpus_setup):
     out_old = np.asarray(jax.jit(old_inline_fwd)(params, packed))
     out_new = np.asarray(new_fwd(params, packed))
     np.testing.assert_array_equal(out_old, out_new)
+
+
+def test_predictor_sequence_packing_matches_padmax_scores(corpus_setup, tmp_path):
+    """ISSUE-5 acceptance: offline eval rides the sequence packer — every
+    chunk is scored exactly once inside a packed row (block-diagonal
+    attention, per-segment heads) and the per-chunk answerability scores,
+    chunk-relative spans and labels must match the pad-to-max path (packing
+    must not change any chunk's math beyond fp reduction noise)."""
+    from ml_recipe_tpu.data.datasets import ChunkDataset
+
+    tok, _, _ = corpus_setup
+    # a corpus big enough for several packed batches with diverse lengths
+    pre = RawPreprocessor(
+        raw_json=write_corpus(
+            tmp_path, [nq_line(example_id=str(i)) for i in range(30)]
+        ),
+        out_dir=tmp_path / "proc",
+    )
+    _, _, (train_idx, _, val_idx, _) = pre()
+    indexes = np.concatenate([train_idx, val_idx])
+    dataset = ChunkDataset(
+        tmp_path / "proc", tok, indexes, max_seq_len=48, max_question_len=16,
+        doc_stride=8, split_by_sentence=False, cache_size=0,
+    )
+    model, params = _tiny_model(tok, max_len=48)
+    collate = init_collate_fun(tok, max_seq_len=48, return_items=True)
+
+    def run(**kw):
+        p = Predictor(
+            model, params, mesh=build_mesh("data:1"), collate_fun=collate,
+            batch_size=8, n_jobs=2, **kw,
+        )
+        p(dataset, save_dump=True)
+        out = {}
+        for s, st, en, lab, items in p.dump:
+            for i, it in enumerate(items):
+                key = (it.item_id, it.chunk_start)
+                assert key not in out, f"chunk {key} scored twice"
+                out[key] = (float(s[i]), int(st[i]), int(en[i]), int(lab[i]))
+        return out, p
+
+    pad_scores, _ = run()
+    packed_scores, packed_p = run(sequence_packing=True)
+    # same chunks scored exactly once on both paths
+    assert set(packed_scores) == set(pad_scores) and len(pad_scores) > 8
+    for key, (score, st, en, lab) in pad_scores.items():
+        p_score, p_st, p_en, p_lab = packed_scores[key]
+        np.testing.assert_allclose(
+            p_score, score, rtol=1e-4, atol=1e-5,
+            err_msg=f"packed score diverged for chunk {key}",
+        )
+        assert (p_st, p_en, p_lab) == (st, en, lab), (
+            f"packed span/label diverged for chunk {key}"
+        )
+    # candidate bookkeeping agrees too (same validity rules on the
+    # chunk-relative spans)
+    _, pad_p = run()
+    assert set(packed_p.candidates) == set(pad_p.candidates)
+
+
+def test_predictor_packing_supersedes_length_buckets(corpus_setup, caplog):
+    import logging
+
+    tok, val_dataset, _ = corpus_setup
+    model, params = _tiny_model(tok)
+    with caplog.at_level(logging.INFO):
+        p = Predictor(
+            model, params, mesh=build_mesh("data:1"),
+            collate_fun=init_collate_fun(tok, max_seq_len=64, return_items=True),
+            batch_size=8, n_jobs=2, length_buckets=[32, 64],
+            sequence_packing=True,
+        )
+    assert p._packing and p._seq_grid is None
+    assert "supersedes length_buckets" in caplog.text

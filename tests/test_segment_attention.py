@@ -1,0 +1,237 @@
+"""Segment-aware (block-diagonal) attention kernels — ISSUE 5 satellite.
+
+Interpret-mode parity of all three Pallas regimes (fused L<=512, q-blocked
+resident-KV, streaming-KV) against a dense block-diagonal reference, forward
+AND backward, including dropout-mask regeneration and a mixed batch (packed
+rows + a full-length single-segment row). The comparison masks pad query
+rows: a fully-masked row softmaxes over all -inf and produces finite
+garbage by contract (the model never consumes pad-row outputs) — the
+kernels additionally ZERO those rows' backward contributions where the
+autodiff reference leaks uniform-probability garbage into real dk/dv, so
+gradients are compared through a pad-masked loss on both sides.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ml_recipe_tpu.ops.attention import _xla_attention, dot_product_attention
+from ml_recipe_tpu.ops.flash_attention import flash_attention
+from ml_recipe_tpu.ops.flash_streaming import streaming_attention
+
+pytestmark = pytest.mark.unit
+
+
+def _qkv(rng, B, L, H, D):
+    return tuple(
+        jnp.asarray(rng.standard_normal((B, L, H, D)), jnp.float32)
+        for _ in range(3)
+    )
+
+
+def _segments(B, L, splits):
+    """[B, L] segment ids from per-row segment lengths (0 = trailing pad)."""
+    seg = np.zeros((B, L), np.int32)
+    for b, row in enumerate(splits):
+        off = 0
+        for s, n in enumerate(row):
+            seg[b, off:off + n] = s + 1
+            off += n
+        assert off <= L
+    return jnp.asarray(seg)
+
+
+def _check_regime(fn, q, k, v, seg, *, rtol=2e-5, atol=2e-5):
+    """fwd + bwd parity of ``fn`` against the dense block-diagonal
+    reference, on valid (non-pad) rows."""
+    valid = (np.asarray(seg) > 0).astype(np.float32)[:, :, None, None]
+
+    def ref(q, k, v):
+        return _xla_attention(q, k, v, None, dtype=jnp.float32,
+                              segment_ids=seg)
+
+    np.testing.assert_allclose(
+        np.asarray(fn(q, k, v) * valid), np.asarray(ref(q, k, v) * valid),
+        rtol=rtol, atol=atol,
+    )
+
+    def loss(f, q, k, v):
+        return jnp.sum((f(q, k, v) * valid) ** 2)
+
+    gk = jax.grad(lambda *a: loss(fn, *a), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: loss(ref, *a), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gk, gr, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=rtol, atol=atol,
+            err_msg=f"{name} diverged from the dense block-diagonal "
+                    f"reference",
+        )
+
+
+def test_fused_segmented_matches_dense_reference():
+    """Fully-fused regime (L <= 512), mixed batch: a 3-segment packed row
+    with trailing pad + a full-length single-segment row."""
+    rng = np.random.default_rng(0)
+    B, L, H, D = 2, 128, 2, 64
+    q, k, v = _qkv(rng, B, L, H, D)
+    seg = _segments(B, L, [[40, 50, 30], [128]])
+    _check_regime(
+        lambda q, k, v: flash_attention(
+            q, k, v, seg, dtype=jnp.float32, interpret=True, segmented=True
+        ),
+        q, k, v, seg,
+    )
+
+
+def test_blocked_segmented_matches_dense_reference():
+    """q-blocked resident-KV regime (L > 512): the q-block's segment ids
+    come from a dynamic slice of the whole mask row."""
+    rng = np.random.default_rng(1)
+    B, L, H, D = 1, 1024, 2, 64
+    q, k, v = _qkv(rng, B, L, H, D)
+    seg = _segments(B, L, [[300, 400, 200]])  # 124 pad
+    _check_regime(
+        lambda q, k, v: flash_attention(
+            q, k, v, seg, dtype=jnp.float32, interpret=True, segmented=True
+        ),
+        q, k, v, seg,
+    )
+
+
+def test_streaming_segmented_matches_dense_reference():
+    """Streaming-KV regime: both mask slices (q and k side) are dynamic
+    slices of the resident full segment-id row."""
+    rng = np.random.default_rng(2)
+    B, L, H, D = 1, 1024, 2, 64
+    q, k, v = _qkv(rng, B, L, H, D)
+    seg = _segments(B, L, [[300, 400, 200]])
+    _check_regime(
+        lambda q, k, v: streaming_attention(
+            q, k, v, seg, dtype=jnp.float32, interpret=True, segmented=True
+        ),
+        q, k, v, seg,
+    )
+
+
+def test_streaming_segmented_mixed_full_row():
+    rng = np.random.default_rng(3)
+    B, L, H, D = 2, 1024, 2, 64
+    q, k, v = _qkv(rng, B, L, H, D)
+    seg = _segments(B, L, [[512, 256, 200], [1024]])
+    _check_regime(
+        lambda q, k, v: streaming_attention(
+            q, k, v, seg, dtype=jnp.float32, interpret=True, segmented=True
+        ),
+        q, k, v, seg,
+    )
+
+
+def test_single_full_segment_matches_unsegmented_kernel():
+    """A batch of single-segment full rows through the SEGMENTED kernel
+    must agree with the plain key-mask kernel on the same data (the packed
+    path's degenerate case)."""
+    rng = np.random.default_rng(4)
+    B, L, H, D = 2, 128, 2, 64
+    q, k, v = _qkv(rng, B, L, H, D)
+    seg = _segments(B, L, [[128], [128]])
+    out_seg = flash_attention(q, k, v, seg, dtype=jnp.float32,
+                              interpret=True, segmented=True)
+    mask = jnp.ones((B, L), jnp.int32)
+    out_plain = flash_attention(q, k, v, mask, dtype=jnp.float32,
+                                interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out_seg), np.asarray(out_plain), rtol=1e-6, atol=1e-6
+    )
+
+
+@pytest.mark.parametrize("regime,L", [("fused", 128), ("stream", 1024)])
+def test_segmented_dropout_deterministic_and_seed_sensitive(regime, L):
+    """Dropout in the segmented kernels: the same seed regenerates the
+    exact mask (two forwards identical — the property the backward's mask
+    regeneration rests on), a different seed draws a different one, and
+    gradients flow finitely through fwd+bwd."""
+    rng = np.random.default_rng(5)
+    B, H, D = 1, 2, 64
+    q, k, v = _qkv(rng, B, L, H, D)
+    seg = _segments(B, L, [[L // 4, L // 2, L // 8]])
+    fn = flash_attention if regime == "fused" else streaming_attention
+
+    def run(seed):
+        return fn(q, k, v, seg, seed=jnp.asarray([seed], jnp.int32),
+                  dtype=jnp.float32, rate=0.2, interpret=True,
+                  segmented=True)
+
+    a, b = run(123), run(123)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = run(321)
+    assert not np.allclose(np.asarray(a), np.asarray(c))
+
+    g = jax.grad(
+        lambda q: jnp.sum(
+            fn(q, k, v, seg, seed=jnp.asarray([123], jnp.int32),
+               dtype=jnp.float32, rate=0.2, interpret=True,
+               segmented=True) ** 2
+        )
+    )(q)
+    assert bool(jnp.all(jnp.isfinite(g)))
+
+
+def test_segmented_dropout_zero_rate_matches_no_dropout():
+    rng = np.random.default_rng(6)
+    B, L, H, D = 1, 128, 2, 64
+    q, k, v = _qkv(rng, B, L, H, D)
+    seg = _segments(B, L, [[60, 40]])
+    a = flash_attention(q, k, v, seg, seed=jnp.asarray([9], jnp.int32),
+                        dtype=jnp.float32, rate=0.0, interpret=True,
+                        segmented=True)
+    b = flash_attention(q, k, v, seg, dtype=jnp.float32, interpret=True,
+                        segmented=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# dispatcher
+# ---------------------------------------------------------------------------
+
+
+def test_dispatcher_xla_path_applies_segments():
+    rng = np.random.default_rng(7)
+    B, L, H, D = 2, 64, 2, 8
+    q, k, v = _qkv(rng, B, L, H, D)
+    seg = _segments(B, L, [[20, 30], [64]])
+    out = dot_product_attention(q, k, v, None, dtype=jnp.float32,
+                                impl="xla", segment_ids=seg)
+    ref = _xla_attention(q, k, v, None, dtype=jnp.float32, segment_ids=seg)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    # tokens of segment 1 must be unaffected by segment 2's content
+    v2 = v.at[:, 25:, :, :].set(0.0)
+    k2 = k.at[:, 25:, :, :].set(9.0)
+    out2 = dot_product_attention(q, k2, v2, None, dtype=jnp.float32,
+                                 impl="xla", segment_ids=seg)
+    np.testing.assert_allclose(
+        np.asarray(out[0, :20]), np.asarray(out2[0, :20]),
+        rtol=1e-6, atol=1e-6,
+    )
+
+
+def test_dispatcher_rejects_ring_with_segments():
+    rng = np.random.default_rng(8)
+    q, k, v = _qkv(rng, 1, 64, 2, 8)
+    seg = _segments(1, 64, [[64]])
+    with pytest.raises(ValueError, match="ring"):
+        dot_product_attention(q, k, v, None, impl="ring", segment_ids=seg)
+
+
+def test_dispatcher_auto_on_cpu_routes_segmented_to_xla():
+    """On the CPU backend impl='auto' must keep working with segment_ids
+    (routes to the XLA path — same result as impl='xla')."""
+    rng = np.random.default_rng(9)
+    q, k, v = _qkv(rng, 1, 64, 2, 8)
+    seg = _segments(1, 64, [[30, 20]])
+    a = dot_product_attention(q, k, v, None, dtype=jnp.float32,
+                              impl="auto", segment_ids=seg)
+    b = dot_product_attention(q, k, v, None, dtype=jnp.float32,
+                              impl="xla", segment_ids=seg)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

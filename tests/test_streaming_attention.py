@@ -275,12 +275,12 @@ def test_dispatcher_routes_streaming_beyond_resident(monkeypatch):
     calls = []
     monkeypatch.setattr(
         fs, "streaming_attention",
-        lambda q, k, v, mask, seed=None, dtype=None, rate=0.0:
+        lambda q, k, v, mask, seed=None, dtype=None, rate=0.0, segmented=False:
         (calls.append(("streaming", q.shape[1])), jnp.zeros(q.shape, dtype))[1],
     )
     monkeypatch.setattr(
         fa, "flash_attention",
-        lambda q, k, v, mask, seed=None, dtype=None, rate=0.0:
+        lambda q, k, v, mask, seed=None, dtype=None, rate=0.0, segmented=False:
         (calls.append(("resident", q.shape[1])), jnp.zeros(q.shape, dtype))[1],
     )
     monkeypatch.setattr(attn.jax, "default_backend", lambda: "tpu")
