@@ -443,6 +443,30 @@ def bench_input(args) -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _quantize_for_bench(args, model, params, make_batches):
+    """Shared int8 leg of bench_infer/bench_serve: convert the float pair,
+    measure span parity vs the float path on ``make_batches()`` (built
+    lazily — only the int8 path pays for it), and return the pair the
+    benchmark should run plus the JSON ``quant_fields`` both modes emit
+    (identical schema either way, so the two lines never diverge)."""
+    quantize = getattr(args, "quantize", "off")
+    quant_fields = {"quantize": quantize, "quant_mem_bytes": None,
+                    "parity_span_agreement": None,
+                    "parity_score_max_delta": None}
+    if quantize == "int8":
+        from ml_recipe_tpu.quant import quantize_model, span_parity
+
+        qmodel, qparams, qreport = quantize_model(model, params)
+        parity = span_parity(model, params, qmodel, qparams, make_batches())
+        quant_fields.update(
+            quant_mem_bytes=qreport["quant_bytes"],
+            parity_span_agreement=parity["span_agreement"],
+            parity_score_max_delta=parity["score_max_abs_delta"],
+        )
+        model, params = qmodel, qparams
+    return model, params, quant_fields
+
+
 def bench_infer(args) -> None:
     import shutil
     import tempfile
@@ -493,6 +517,24 @@ def bench_infer(args) -> None:
             jax.random.key(0), np.zeros((1, 8), dtype=np.int32)
         )["params"]
         collate = init_collate_fun(tokenizer, max_seq_len=L, return_items=True)
+
+        # int8 path: convert, measure span parity vs the float path on a
+        # sample of real collated chunks, then bench the QUANTIZED predictor
+        def make_batches():
+            sample_ds = make_dataset(indexes[:8])
+            # dataset[i] is one DOCUMENT's chunk list — flatten to chunks
+            sample = [
+                chunk
+                for i in range(min(len(sample_ds), 8))
+                for chunk in sample_ds[i]
+            ][:32]
+            return [
+                collate(sample[at: at + 8])[0]
+                for at in range(0, len(sample), 8)
+            ]
+
+        model, params, quant_fields = _quantize_for_bench(
+            args, model, params, make_batches)
 
         predictor = Predictor(
             model, params, mesh=mesh, collate_fun=collate,
@@ -551,6 +593,7 @@ def bench_infer(args) -> None:
                         per_chip * (real_tokens / chunks), 1
                     ) if chunks else None,
                     "ln_impl": args.ln_impl,
+                    **quant_fields,
                     "chunks": chunks,
                     "docs": int(len(indexes)),
                     "chunks_per_sec_windows": [round(r, 1) for r in window_rates],
@@ -611,18 +654,33 @@ def bench_serve(args) -> None:
             jax.random.key(0), np.zeros((1, 8), dtype=np.int32)
         )["params"]
 
+        rng = np.random.default_rng(0)
+        requests = [
+            make_learnable_line(i, rng) for i in range(args.serve_requests)
+        ]
+
+        # int8 path: convert, measure span parity vs the float path on the
+        # first requests' real chunks, then serve the QUANTIZED pair
+        def make_batches():
+            from ml_recipe_tpu.quant import make_parity_batches
+
+            return make_parity_batches(
+                tokenizer, requests[:8], max_seq_len=grid.max_seq,
+                max_question_len=16, doc_stride=args.doc_stride,
+            )
+
+        model, params, quant_fields = _quantize_for_bench(
+            args, model, params, make_batches)
+        quantize = quant_fields["quantize"]
+
         engine = QAEngine(
             model, params, tokenizer, grid=grid, mesh=mesh,
             max_batch_delay_ms=args.max_batch_delay_ms,
             queue_size=args.serve_queue_size,
             max_question_len=16, doc_stride=args.doc_stride,
+            quantize=quantize,
         )
         warm = engine.warmup(hbm_preflight=args.hbm_preflight)
-
-        rng = np.random.default_rng(0)
-        requests = [
-            make_learnable_line(i, rng) for i in range(args.serve_requests)
-        ]
 
         lock = threading.Lock()
         next_i = [0]
@@ -691,6 +749,7 @@ def bench_serve(args) -> None:
                     "batch_occupancy_mean": round(occ, 4) if occ else None,
                     "padding_waste_mean": round(waste, 4) if waste else None,
                     "buckets": [str(b) for b in grid],
+                    **quant_fields,
                     "max_batch_delay_ms": args.max_batch_delay_ms,
                     "warmup_seconds": warm["warmup_seconds"],
                     "autotune_probes": warm["autotune"]["probes"],
@@ -908,6 +967,13 @@ def main() -> None:
     parser.add_argument("--hbm_preflight", type=_str2bool, default=True,
                         help="Raise batch_split from compiled "
                              "memory_analysis instead of OOMing in XLA.")
+    parser.add_argument("--quantize", type=str, default="off",
+                        choices=["off", "int8"],
+                        help="infer/serve modes: post-training int8 "
+                             "quantization of the scoring path (quant/) — "
+                             "the JSON line gains quantize / "
+                             "quant_mem_bytes / parity_* fields either "
+                             "way.")
     args = parser.parse_args()
 
     if args.mode == "input":

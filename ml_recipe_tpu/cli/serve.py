@@ -42,7 +42,8 @@ def main(params, model_params) -> int:
     )
 
     model, model_state, tokenizer = init_model(
-        model_params, checkpoint=params.checkpoint
+        model_params, checkpoint=params.checkpoint,
+        quantize=getattr(params, "quantize", "off"),
     )
     mesh = build_mesh(getattr(params, "mesh", None))
 
@@ -56,6 +57,7 @@ def main(params, model_params) -> int:
         queue_size=params.queue_size,
         max_question_len=params.max_question_len,
         doc_stride=params.doc_stride,
+        quantize=getattr(params, "quantize", "off"),
     )
     engine.warmup(hbm_preflight=params.hbm_preflight)
 

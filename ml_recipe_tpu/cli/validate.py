@@ -30,8 +30,12 @@ def main(params, model_params):
     show_params(model_params, "model")
     show_params(params, "predictor")
 
+    # --quantize int8: offline eval of the int8 serving path — the same
+    # conversion the serving engine performs at startup, so span-level
+    # accuracy of a quantized deployment can be measured before it ships
     model, model_state, tokenizer = init_model(
-        model_params, checkpoint=params.checkpoint
+        model_params, checkpoint=params.checkpoint,
+        quantize=getattr(params, "quantize", "off"),
     )
 
     val_dataset = init_validation_dataset(params, tokenizer=tokenizer, clear=False)

@@ -96,12 +96,19 @@ def init_model(
     bpe_dropout: Optional[float] = None,
     rng_seed: int = 0,
     mesh=None,
+    quantize: str = "off",
 ) -> Tuple[QAModel, dict, object]:
     """Build (model, params, tokenizer) — reference init.py:51-82.
 
     Weight priority: explicit ``checkpoint`` (our msgpack format, model part
     only — the reference's strict=False torch.load, init.py:43-48) >
     ``model_params.hf_checkpoint`` (converted HF torch weights) > random init.
+
+    ``quantize='int8'`` (serving/eval only): AFTER the float checkpoint is
+    restored, the (model, params) pair is converted through
+    ``quant.quantize_model`` — post-training per-channel int8, no
+    retraining, any existing checkpoint — and the per-layer error summary
+    is logged. The checkpoint format itself never changes.
     """
     import jax.numpy as jnp
 
@@ -141,6 +148,21 @@ def init_model(
         params, _, _, loaded_step = load_state_dict(checkpoint, params=params)
         if loaded_step is not None:
             logger.info(f"Model checkpoint was restored from {checkpoint}.")
+
+    if quantize not in (None, "off"):
+        from .quant import quantize_model
+
+        model, params, report = quantize_model(model, params, quantize)
+        logger.info(
+            "Post-training quantization (%s): %d kernels converted, "
+            "params %.1f -> %.1f MB (kernels %.1f -> %.1f MB), worst "
+            "per-layer relative RMS error %.4f.",
+            quantize, report["n_quantized"],
+            report["orig_bytes"] / 1e6, report["quant_bytes"] / 1e6,
+            report["orig_kernel_bytes"] / 1e6,
+            report["quant_kernel_bytes"] / 1e6,
+            report["max_rel_rms_err"],
+        )
 
     return model, params, tokenizer
 

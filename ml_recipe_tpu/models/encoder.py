@@ -53,6 +53,23 @@ def _ln(cfg: EncoderConfig, dtype, ln_impl: str, name: str):
                           impl=ln_impl, name=name)
 
 
+def _dense(quantize: str, features: int, *, name: str, dtype):
+    """Dense factory for the matmul-dominant projections: 'off' keeps
+    flax's nn.Dense bit-identically (params AND arithmetic — the default
+    serving/training path is untouched); 'int8' swaps in QuantDense
+    (quant/layers.py) under the SAME module name, so a converted checkpoint
+    tree (quant/quantize.py) lands on exactly these params."""
+    if quantize == "int8":
+        from ..quant.layers import QuantDense
+
+        return QuantDense(features, name=name, dtype=dtype)
+    if quantize not in (None, "off"):
+        raise ValueError(
+            f"quantize must be 'off' or 'int8', got {quantize!r}"
+        )
+    return nn.Dense(features, name=name, dtype=dtype)
+
+
 class Embeddings(nn.Module):
     cfg: EncoderConfig
     dtype: jnp.dtype = jnp.float32
@@ -113,6 +130,7 @@ class SelfAttention(nn.Module):
     attention_impl: str = "xla"
     mesh: Any = None  # required by impl='ring' (sequence parallelism)
     ln_impl: str = "xla"
+    quantize: str = "off"  # int8 serving path (quant/): QKV + out proj
 
     @nn.compact
     def __call__(self, hidden, mask, *, deterministic: bool,
@@ -121,7 +139,8 @@ class SelfAttention(nn.Module):
         B, L, H = hidden.shape
 
         def heads(name):
-            y = nn.Dense(cfg.hidden_size, name=name, dtype=self.dtype)(hidden)
+            y = _dense(self.quantize, cfg.hidden_size, name=name,
+                       dtype=self.dtype)(hidden)
             return y.reshape(B, L, cfg.num_heads, cfg.head_dim)
 
         q, k, v = heads("query"), heads("key"), heads("value")
@@ -141,7 +160,8 @@ class SelfAttention(nn.Module):
         )
         ctx = ctx.reshape(B, L, cfg.hidden_size)
 
-        out = nn.Dense(cfg.hidden_size, name="output", dtype=self.dtype)(ctx)
+        out = _dense(self.quantize, cfg.hidden_size, name="output",
+                     dtype=self.dtype)(ctx)
         out = nn.Dropout(cfg.hidden_dropout_prob)(out, deterministic=deterministic)
         return _ln(cfg, self.dtype, self.ln_impl, "layer_norm")(hidden + out)
 
@@ -150,13 +170,16 @@ class FeedForward(nn.Module):
     cfg: EncoderConfig
     dtype: jnp.dtype = jnp.float32
     ln_impl: str = "xla"
+    quantize: str = "off"
 
     @nn.compact
     def __call__(self, hidden, *, deterministic: bool):
         cfg = self.cfg
-        y = nn.Dense(cfg.intermediate_size, name="intermediate", dtype=self.dtype)(hidden)
+        y = _dense(self.quantize, cfg.intermediate_size, name="intermediate",
+                   dtype=self.dtype)(hidden)
         y = nn.gelu(y, approximate=False)
-        y = nn.Dense(cfg.hidden_size, name="output", dtype=self.dtype)(y)
+        y = _dense(self.quantize, cfg.hidden_size, name="output",
+                   dtype=self.dtype)(y)
         y = nn.Dropout(cfg.hidden_dropout_prob)(y, deterministic=deterministic)
         return _ln(cfg, self.dtype, self.ln_impl, "layer_norm")(hidden + y)
 
@@ -167,15 +190,18 @@ class EncoderLayer(nn.Module):
     attention_impl: str = "xla"
     mesh: Any = None
     ln_impl: str = "xla"
+    quantize: str = "off"
 
     @nn.compact
     def __call__(self, hidden, mask, deterministic: bool = True,
                  segment_ids=None):
         hidden = SelfAttention(self.cfg, self.dtype, self.attention_impl,
-                               self.mesh, self.ln_impl, name="attention")(
+                               self.mesh, self.ln_impl,
+                               quantize=self.quantize, name="attention")(
                                hidden, mask, deterministic=deterministic,
                                segment_ids=segment_ids)
-        hidden = FeedForward(self.cfg, self.dtype, self.ln_impl, name="mlp")(
+        hidden = FeedForward(self.cfg, self.dtype, self.ln_impl,
+                             quantize=self.quantize, name="mlp")(
             hidden, deterministic=deterministic
         )
         return hidden
@@ -190,6 +216,10 @@ class TransformerEncoder(nn.Module):
     remat: bool = False
     mesh: Any = None
     ln_impl: str = "xla"
+    # 'int8': serving-only post-training quantization (quant/) — every
+    # matmul-dominant Dense (QKV/attn-out/FFN/pooler) runs the fused int8
+    # path; 'off' (default) is bit-identical to the historical model
+    quantize: str = "off"
 
     @nn.compact
     def __call__(
@@ -220,7 +250,8 @@ class TransformerEncoder(nn.Module):
 
         for i in range(cfg.num_layers):
             hidden = layer_cls(cfg, self.dtype, self.attention_impl, self.mesh,
-                               self.ln_impl, name=f"layer_{i}")(
+                               self.ln_impl, quantize=self.quantize,
+                               name=f"layer_{i}")(
                                hidden, attention_mask, deterministic,
                                segment_ids)
 
@@ -235,7 +266,8 @@ class TransformerEncoder(nn.Module):
             pool_src = jnp.take_along_axis(
                 hidden, segment_starts[..., None].astype(jnp.int32), axis=1
             )
-        pooled = nn.Dense(cfg.hidden_size, name="pooler", dtype=self.dtype)(pool_src)
+        pooled = _dense(self.quantize, cfg.hidden_size, name="pooler",
+                        dtype=self.dtype)(pool_src)
         pooled = jnp.tanh(pooled)
 
         return hidden, pooled

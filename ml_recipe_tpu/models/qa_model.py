@@ -31,7 +31,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from .config import EncoderConfig
-from .encoder import TransformerEncoder
+from .encoder import TransformerEncoder, _dense
 
 QA_OUTPUT_KEYS = ("start_class", "end_class", "start_reg", "end_reg", "cls")
 
@@ -52,6 +52,12 @@ class QAModel(nn.Module):
     # already fusing the LN work into matmul epilogues. Full decomposition:
     # artifacts/r4/elementwise_floor{,_lnfused}.json + bench_seq512_*.json.
     ln_impl: str = "xla"
+    # 'int8': serving-only post-training quantization (quant/) — the
+    # encoder's matmul Denses AND the QA heads run the fused int8 path on a
+    # converted checkpoint tree (quant.quantize_model). 'off' (default) is
+    # bit-identical to the historical model: same modules, same params,
+    # same arithmetic. Inference-only — the trainer never sets this.
+    quantize: str = "off"
 
     @nn.compact
     def __call__(
@@ -78,7 +84,7 @@ class QAModel(nn.Module):
 
         sequence_output, pooled_output = TransformerEncoder(
             cfg, self.dtype, self.attention_impl, self.remat, self.mesh,
-            self.ln_impl, name="transformer"
+            self.ln_impl, quantize=self.quantize, name="transformer"
         )(
             input_ids,
             attention_mask=attention_mask,
@@ -90,9 +96,8 @@ class QAModel(nn.Module):
         )
 
         # span start/end logits over token positions (model.py:30,54-58)
-        position_logits = nn.Dense(2, name="position_outputs", dtype=self.dtype)(
-            sequence_output
-        )
+        position_logits = _dense(self.quantize, 2, name="position_outputs",
+                                 dtype=self.dtype)(sequence_output)
         start_logits = position_logits[..., 0]
         end_logits = position_logits[..., 1]
 
@@ -124,15 +129,18 @@ class QAModel(nn.Module):
         cls_hidden = nn.Dropout(cfg.hidden_dropout_prob)(
             pooled_output, deterministic=deterministic
         )
-        classifier_logits = nn.Dense(cfg.num_labels, name="classifier",
-                                     dtype=self.dtype)(cls_hidden)
+        classifier_logits = _dense(self.quantize, cfg.num_labels,
+                                   name="classifier",
+                                   dtype=self.dtype)(cls_hidden)
 
         # normalized-position regressors (model.py:37-41,64-65)
         reg_start = nn.sigmoid(
-            nn.Dense(1, name="reg_start", dtype=self.dtype)(pooled_output)
+            _dense(self.quantize, 1, name="reg_start",
+                   dtype=self.dtype)(pooled_output)
         )[..., 0]
         reg_end = nn.sigmoid(
-            nn.Dense(1, name="reg_end", dtype=self.dtype)(pooled_output)
+            _dense(self.quantize, 1, name="reg_end",
+                   dtype=self.dtype)(pooled_output)
         )[..., 0]
 
         return {

@@ -14,8 +14,11 @@ mechanism for every regime:
   cost* (fewer programs / less HBM re-streaming = cheaper);
 - candidates are ranked by that cost and validated IN RANK ORDER with a real
   ``jit(...).lower(...).compile()`` probe of the same ``pallas_call`` the
-  execution path builds — the first candidate the toolchain accepts wins, so
-  the winner is both measured-legal and model-optimal among legal ones;
+  execution path builds; when the probes hand back their compiled objects,
+  legal candidates are re-ranked by XLA's own ``cost_analysis()`` estimates
+  (measured properties of the lowered programs — fusions and layout copies
+  included) and the cheapest wins, the analytic prior deciding only walk
+  order and ties; bool-style probes keep first-legal-wins;
 - off-TPU (CPU / interpret mode, where Mosaic cannot OOM VMEM and tier-1
   runs) selection falls back to the caller's analytic pick — the exact
   arithmetic the old gates used, so CPU behavior is unchanged;
@@ -101,6 +104,90 @@ def _device_kind() -> str:
 
 def _sanitize(kind: str) -> str:
     return re.sub(r"[^A-Za-z0-9._-]+", "_", kind.strip()) or "unknown"
+
+
+# Nominal chip ceilings for the roofline-lite ranking signal below. These
+# are RANKING constants, not measurements: only the relative ordering of
+# candidates matters, and max(flops/F, bytes/B) orders compute-bound and
+# bandwidth-bound candidates sanely for any plausible F/B pair. (v5e-ish:
+# ~197 bf16 TFLOP/s, ~819 GB/s.)
+_RANK_PEAK_FLOPS = 197e12
+_RANK_PEAK_BYTES = 819e9
+
+
+def _cost_estimate(compiled) -> Optional[dict]:
+    """Compiled-cost estimate of one probe result, or ``None`` when the
+    toolchain exposes none (ranking then falls back to the analytic prior).
+
+    ``compiled.cost_analysis()`` is XLA's own post-optimization estimate —
+    a *measured* property of the lowered program (fusion decisions, layout
+    copies, re-streaming included), unlike the caller's analytic prior
+    which models the kernel it HOPED to get. ``est_seconds`` is the
+    roofline-lite scalar the ranking minimizes; the raw flops/bytes persist
+    alongside it in the tuning cache for provenance.
+    """
+    fn = getattr(compiled, "cost_analysis", None)
+    if fn is None:
+        return None
+    try:
+        ca = fn()
+    except Exception:  # noqa: BLE001 - estimate is best-effort by contract
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    try:
+        flops = float(ca.get("flops") or 0.0)
+        byts = float(ca.get("bytes accessed") or 0.0)
+    except (TypeError, ValueError):
+        return None
+    if flops <= 0.0 and byts <= 0.0:
+        return None
+    return {
+        "flops": flops,
+        "bytes_accessed": byts,
+        "est_seconds": max(flops / _RANK_PEAK_FLOPS,
+                           byts / _RANK_PEAK_BYTES),
+    }
+
+
+def _geom_json_key(geometry) -> str:
+    """Stable JSON-object key for one candidate geometry."""
+    if isinstance(geometry, (list, tuple)):
+        return "x".join(str(g) for g in geometry)
+    return str(geometry)
+
+
+class _CombinedCompiled:
+    """Several compiled programs presented as ONE rankable probe result:
+    ``cost_analysis()`` sums their flops / bytes-accessed (a candidate that
+    must compile forward AND backward is as expensive as both)."""
+
+    def __init__(self, compiled: Sequence[Any]):
+        self._compiled = list(compiled)
+
+    def cost_analysis(self):
+        total = {"flops": 0.0, "bytes accessed": 0.0}
+        for compiled in self._compiled:
+            est = _cost_estimate(compiled)
+            if est is None:
+                # one leg without an estimate poisons the sum — report
+                # nothing rather than a half-truth (ranking falls back to
+                # the analytic prior)
+                return None
+            total["flops"] += est["flops"]
+            total["bytes accessed"] += est["bytes_accessed"]
+        return total
+
+
+def combine_for_ranking(*compiled):
+    """Wrap the compiled legs of a multi-program candidate (e.g. streaming
+    fwd + dkv) as one probe result the ranking pass can estimate. Falsy legs
+    make the whole candidate infeasible (returns False)."""
+    if not compiled or any(not c for c in compiled):
+        return False
+    return _CombinedCompiled(compiled)
 
 
 @dataclasses.dataclass
@@ -278,7 +365,10 @@ class GeometryAutotuner:
         analytic gates returning ``None``).
 
         On TPU (and not interpret) candidates are probed in ascending
-        modeled-cost order and the first that compiles wins; elsewhere the
+        modeled-cost order; a probe returning the compiled object opts into
+        timing-ranked selection (every candidate probed, winner = smallest
+        ``cost_analysis()`` estimate — see ``_probe_ranked``), a probe
+        returning bare ``True`` keeps first-legal-wins. Elsewhere the
         caller's ``analytic`` pick is returned unchanged (old-gate parity).
         Either way the verdict is cached in memory and on disk, so a second
         invocation at the same key performs zero probes. A probe that raises
@@ -323,20 +413,25 @@ class GeometryAutotuner:
                 return geometry
 
             self.misses += 1
+            ranking = None
+            estimates: Dict[str, dict] = {}
             if can_probe:
                 source = "probe"
-                geometry = None
-                for cand in sorted(candidates, key=cost):
-                    self.probe_count += 1
-                    if probe(cand):
-                        geometry = cand
-                        break
+                geometry, ranking, estimates = self._probe_ranked(
+                    candidates, cost, probe,
+                )
             else:
                 source = "analytic"
                 geometry = analytic() if analytic is not None else None
 
             stored = list(geometry) if isinstance(geometry, tuple) else geometry
             entry = {"geometry": stored, "source": source}
+            if ranking == "measured":
+                # persist the ranking signal: which estimates the winner
+                # beat, and that the verdict came from compiled-cost
+                # ranking rather than the analytic prior
+                entry["ranking"] = ranking
+                entry["cost_estimates"] = estimates
             if geometry is None:
                 # session-only: a "nothing legal" verdict may be a transient
                 # probe-environment failure — don't let it outlive the
@@ -347,6 +442,62 @@ class GeometryAutotuner:
                 self._persist(kind)
             self._record(regime, key, geometry, "miss", source)
             return geometry
+
+    def _probe_ranked(self, candidates, cost, probe):
+        """Probe-validate candidates and pick the winner, preferring
+        measured compiled-cost ranking over the analytic prior.
+
+        Candidates are walked in ascending prior-cost order. A probe that
+        returns a bare ``True`` keeps the legacy contract — the first legal
+        candidate wins and the walk stops (nothing to rank by). A probe
+        that returns the *compiled object* opts into timing-ranked
+        selection: every candidate is probed, ``compiled.cost_analysis()``
+        estimates are collected, and the winner is the legal candidate with
+        the smallest estimated step cost — the prior decides only the walk
+        order and the tie-break (ROADMAP raw-speed item b).
+
+        Probe exceptions before the first legal candidate propagate (the
+        legacy safety contract: an unclassified compile error at a
+        conservative candidate is a kernel bug, see flash_attention's
+        ``_probe_compiles``); once a legal winner exists, ranking probes
+        are best-effort — a failure there logs and skips the candidate
+        rather than killing a selection that already has an answer.
+
+        Returns ``(geometry, ranking, estimates)`` with ranking in
+        ``('measured', 'prior', None)``.
+        """
+        legal: List[Any] = []
+        estimates: Dict[str, dict] = {}
+        for cand in sorted(candidates, key=cost):
+            self.probe_count += 1
+            if legal:
+                try:
+                    res = probe(cand)
+                except Exception as e:  # noqa: BLE001 - ranking extras only
+                    logger.warning(
+                        "autotune: ranking probe failed for candidate %r "
+                        "(%s); skipping it", cand, e,
+                    )
+                    continue
+            else:
+                res = probe(cand)
+            if not res:
+                continue
+            est = _cost_estimate(res) if res is not True else None
+            legal.append(cand)
+            if est is None:
+                # bool-style probe (or no cost model available): legacy
+                # first-legal-wins — further probes buy nothing
+                break
+            estimates[_geom_json_key(cand)] = est
+        if not legal:
+            return None, None, {}
+        if len(estimates) == len(legal) and len(legal) > 1:
+            winner = min(
+                legal, key=lambda c: estimates[_geom_json_key(c)]["est_seconds"]
+            )
+            return winner, "measured", estimates
+        return legal[0], "prior", estimates
 
     # -- session provenance (bench JSON) --------------------------------------
 

@@ -767,11 +767,14 @@ def _looks_like_vmem_overflow(err: Exception) -> bool:
             or "scoped" in msg or "out of memory" in msg)
 
 
-def _probe_compiles(call, arg_shapes, *, aggressive: bool) -> bool:
+def _probe_compiles(call, arg_shapes, *, aggressive: bool):
     """AOT-compile one candidate's ``pallas_call`` (fresh ShapeDtypeStructs,
     no tracers — safe inside an outer trace) and classify the outcome:
 
-    - compiles: the candidate is legal;
+    - compiles: the candidate is legal — the COMPILED object is returned so
+      the autotuner can rank legal candidates by their
+      ``cost_analysis()`` estimates instead of the analytic prior alone
+      (ops/autotune.py ``_probe_ranked``; ROADMAP raw-speed item b);
     - a recognized VMEM-overflow wording: infeasible, the autotuner walks to
       the next-ranked candidate;
     - an UNCLASSIFIED compile error at an ``aggressive`` candidate (one
@@ -783,8 +786,7 @@ def _probe_compiles(call, arg_shapes, *, aggressive: bool) -> bool:
       bug — re-raise rather than silently routing the shape off-kernel.
     """
     try:
-        jax.jit(call).lower(*arg_shapes).compile()
-        return True
+        return jax.jit(call).lower(*arg_shapes).compile()
     except Exception as e:  # noqa: BLE001 - classified below
         if _looks_like_vmem_overflow(e):
             return False

@@ -142,7 +142,8 @@ def _streaming_geometry(L, H, D, in_dtype, out_dtype, rate,
         ]
         fwd = _build_stream_fwd_call(1, L, H, D, in_dtype, out_dtype, rate,
                                      blk, hc, interpret=False, seg=seg)
-        if not _probe_compiles(fwd, fwd_args, aggressive=aggressive):
+        fwd_compiled = _probe_compiles(fwd, fwd_args, aggressive=aggressive)
+        if not fwd_compiled:
             return False
         dkv_args = [
             jax.ShapeDtypeStruct((1,), jnp.int32),          # row seeds
@@ -153,7 +154,12 @@ def _streaming_geometry(L, H, D, in_dtype, out_dtype, rate,
         ]
         dkv = _build_stream_dkv_call(1, L, H, D, in_dtype, rate, blk, hc,
                                      interpret=False, seg=seg)
-        return _probe_compiles(dkv, dkv_args, aggressive=aggressive)
+        # both legs as ONE rankable result: the autotuner ranks legal
+        # candidates by the summed compiled-cost estimate (fwd + dkv)
+        return autotune.combine_for_ranking(
+            fwd_compiled,
+            _probe_compiles(dkv, dkv_args, aggressive=aggressive),
+        )
 
     return autotune.get().select(
         "stream",

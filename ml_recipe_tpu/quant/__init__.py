@@ -1,0 +1,60 @@
+"""Post-training int8 quantization subsystem (serving/inference only).
+
+The serving forward has no gradient-precision constraint, and TPU MXU int8
+peak is ~2x bf16 — this package converts any existing bf16/f32 checkpoint
+to a symmetric per-channel int8 serving tree (no retraining, checkpoints
+stay interchangeable) and measures what the conversion costs:
+
+- ``quantize``: offline weight conversion + per-layer error report;
+- ``layers``: the ``QuantDense`` module the quantized model executes
+  (dynamic per-row activation scaling + the fused int8 matmul in
+  ``ops/quant_matmul.py``);
+- ``calibrate``: the end-to-end span-parity harness vs the float path.
+
+``quantize_model`` is the one-call entry the CLIs and bench use: float
+(model, params) in, (quantized model, quantized params, report) out.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .calibrate import make_parity_batches, score_chunks, span_parity
+from .layers import QuantDense
+from .quantize import (
+    param_bytes,
+    quantize_kernel,
+    quantize_params,
+    weight_kernel_bytes,
+)
+
+__all__ = [
+    "QuantDense",
+    "make_parity_batches",
+    "param_bytes",
+    "quantize_kernel",
+    "quantize_model",
+    "quantize_params",
+    "score_chunks",
+    "span_parity",
+    "weight_kernel_bytes",
+]
+
+
+def quantize_model(model, params, mode: str = "int8"):
+    """Convert a float (model, params) pair to its int8 serving twin.
+
+    Returns ``(qmodel, qparams, report)``: the model is the same module
+    tree with ``quantize='int8'`` (every matmul Dense becomes
+    ``QuantDense``), the params are the converted tree, the report is
+    ``quantize_params``' per-layer error + byte accounting. ``mode='off'``
+    is the identity (callers can wire a flag straight through).
+    """
+    if mode in (None, "off", False):
+        return model, params, {"quantize": "off"}
+    if mode != "int8":
+        raise ValueError(f"unsupported quantization mode {mode!r} "
+                         f"(want 'off' or 'int8')")
+    qparams, report = quantize_params(params)
+    qmodel = dataclasses.replace(model, quantize="int8")
+    return qmodel, qparams, report

@@ -1,0 +1,52 @@
+"""``QuantDense``: the int8 drop-in for ``nn.Dense`` in quantized models.
+
+Parameter structure matches what ``quant.quantize.quantize_params`` emits
+from a float checkpoint — ``kernel_q`` int8 ``[K, N]``, ``kernel_scale``
+f32 ``[N]``, ``bias`` f32 ``[N]`` (the bias passes through conversion
+untouched) — under the SAME module names as the float model, so the only
+difference between the trees is the kernel leaf pair. Init gives zero
+kernels (compile/pre-flight shapes only); real weights always come from
+conversion.
+
+Forward: dynamic per-row activation quantization
+(``ops.quant_matmul.quantize_rowwise``) then the fused int8 matmul
+(``ops.quant_matmul.int8_matmul`` — MXU int8 contraction, exact integer
+accumulation, fused f32 dequant-rescale), bias add in f32, cast to the
+module compute dtype. The arithmetic is identical on every backend; only
+the kernel-vs-XLA routing differs.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.quant_matmul import int8_matmul, quantize_rowwise
+
+
+def _int8_zeros(key, shape):
+    del key
+    return jnp.zeros(shape, jnp.int8)
+
+
+class QuantDense(nn.Module):
+    """Int8-weight Dense: ``y = dequant(act_q8 . kernel_q) + bias``."""
+
+    features: int
+    dtype: jnp.dtype = jnp.float32
+    impl: str = "auto"  # int8_matmul routing: auto | pallas | emulate
+
+    @nn.compact
+    def __call__(self, x):
+        K = x.shape[-1]
+        kernel_q = self.param("kernel_q", _int8_zeros, (K, self.features))
+        kernel_scale = self.param(
+            "kernel_scale", nn.initializers.ones, (self.features,),
+            jnp.float32,
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros, (self.features,), jnp.float32
+        )
+        x_q, x_scale = quantize_rowwise(x)
+        y = int8_matmul(x_q, x_scale, kernel_q, kernel_scale, impl=self.impl)
+        return (y + bias).astype(self.dtype)

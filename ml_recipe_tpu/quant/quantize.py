@@ -1,0 +1,154 @@
+"""Post-training weight quantization: bf16/f32 checkpoints -> int8 trees.
+
+Offline (numpy, host-side) half of the quantization subsystem: walks a
+model's parameter tree and converts every matmul-dominant ``kernel`` leaf to
+symmetric per-OUTPUT-channel int8 — ``kernel_q`` int8 ``[K, N]`` plus
+``kernel_scale`` f32 ``[N]`` with ``kernel_q * kernel_scale ~= kernel`` —
+the exact parameter structure ``quant.layers.QuantDense`` declares, so a
+converted tree drops into a ``quantize='int8'`` model unchanged. Biases,
+LayerNorm scales, embeddings and every other leaf pass through untouched:
+they are VPU-side and a rounding error there buys nothing.
+
+No retraining, no calibration data for the weight side (symmetric max-abs
+per channel is exact enough at BERT scale — the per-layer error report
+quantifies it), and checkpoints stay interchangeable: conversion happens at
+engine startup (``compose.init_model(quantize='int8')``) or offline, always
+FROM the ordinary float checkpoint format.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..ops.quant_matmul import INT8_MAX
+
+# a weight column of exact zeros gets this scale (quantizes to zeros)
+# instead of dividing by zero
+_EPS = 1e-8
+
+__all__ = [
+    "quantize_kernel",
+    "quantize_params",
+    "param_bytes",
+    "weight_kernel_bytes",
+]
+
+
+def quantize_kernel(w: np.ndarray, *, eps: float = _EPS
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Symmetric per-output-channel int8 quantization of one ``[K, N]``
+    kernel: ``scale[n] = max|w[:, n]| / 127`` (floored at ``eps``),
+    ``q = round_half_even(w / scale)`` clipped to ``[-127, 127]``.
+
+    Round half to even matches the in-jit activation grid
+    (``ops.quant_matmul.quantize_rowwise``); weights already ON the grid
+    round-trip exactly (pinned in tests/test_quant.py).
+    """
+    wf = np.asarray(w, np.float32)
+    if wf.ndim != 2:
+        raise ValueError(f"quantize_kernel wants a 2D kernel, got {wf.shape}")
+    amax = np.max(np.abs(wf), axis=0)
+    scale = np.maximum(amax, eps) / INT8_MAX
+    q = np.clip(np.rint(wf / scale[None, :]), -INT8_MAX, INT8_MAX)
+    return q.astype(np.int8), scale.astype(np.float32)
+
+
+def _leaf_bytes(leaf) -> int:
+    # size/dtype come from array attributes — never np.asarray, which would
+    # block on a full device->host copy per leaf (and raise outright on
+    # non-fully-addressable sharded params)
+    size = getattr(leaf, "size", None)
+    dtype = getattr(leaf, "dtype", None)
+    if size is None or dtype is None:
+        arr = np.asarray(leaf)
+        size, dtype = arr.size, arr.dtype
+    return int(size) * int(np.dtype(dtype).itemsize)
+
+
+def param_bytes(params) -> int:
+    """Total bytes of every array leaf in a parameter tree (works on float
+    and quantized trees alike — the serving-side weight-residency number the
+    HBM pre-flight narrative and bench JSON report)."""
+    total = 0
+    stack = [params]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, dict):
+            stack.extend(node.values())
+        else:
+            total += _leaf_bytes(node)
+    return total
+
+
+def weight_kernel_bytes(params) -> int:
+    """Bytes of just the (quantizable or quantized) matmul kernels — the
+    part int8 conversion actually shrinks."""
+    total = 0
+    stack = [params]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, dict):
+            for name, child in node.items():
+                if name in ("kernel", "kernel_q") and not isinstance(
+                        child, dict):
+                    total += _leaf_bytes(child)
+                else:
+                    stack.append(child)
+    return total
+
+
+def _quantize_node(node: dict, path: str, report: List[dict]) -> dict:
+    out: Dict[str, object] = {}
+    for name, child in node.items():
+        sub = f"{path}/{name}" if path else name
+        if isinstance(child, dict):
+            out[name] = _quantize_node(child, sub, report)
+            continue
+        arr = np.asarray(child)
+        if name == "kernel" and arr.ndim == 2:
+            q, scale = quantize_kernel(arr)
+            out["kernel_q"] = q
+            out["kernel_scale"] = scale
+            deq = q.astype(np.float32) * scale[None, :]
+            wf = arr.astype(np.float32)
+            err = deq - wf
+            denom = float(np.sqrt(np.mean(wf ** 2))) or 1.0
+            report.append({
+                "layer": sub,
+                "shape": list(arr.shape),
+                "rms_err": float(np.sqrt(np.mean(err ** 2))),
+                "max_abs_err": float(np.max(np.abs(err))),
+                "rel_rms_err": float(np.sqrt(np.mean(err ** 2))) / denom,
+            })
+        else:
+            out[name] = child
+    return out
+
+
+def quantize_params(params: dict) -> Tuple[dict, dict]:
+    """Convert a float parameter tree to the int8 serving tree.
+
+    Returns ``(qparams, report)``: every 2D ``kernel`` leaf becomes
+    ``kernel_q``/``kernel_scale`` (QKV, attention-out, FFN, pooler, and the
+    QA heads — everything matmul-shaped), all other leaves pass through by
+    reference. The report carries the per-layer quantization error the
+    calibration harness and ``bench.py`` surface, plus the weight-residency
+    delta the serving HBM pre-flight benefits from.
+    """
+    layers: List[dict] = []
+    qparams = _quantize_node(params, "", layers)
+    report = {
+        "quantize": "int8",
+        "layers": layers,
+        "n_quantized": len(layers),
+        "orig_bytes": param_bytes(params),
+        "quant_bytes": param_bytes(qparams),
+        "orig_kernel_bytes": weight_kernel_bytes(params),
+        "quant_kernel_bytes": weight_kernel_bytes(qparams),
+        "max_rel_rms_err": max(
+            (l["rel_rms_err"] for l in layers), default=0.0
+        ),
+    }
+    return qparams, report

@@ -164,6 +164,7 @@ class QAEngine:
         max_question_len: int = 64,
         doc_stride: int = 128,
         registry: Optional[Registry] = None,
+        quantize: str = "off",
     ):
         self.model = model
         self.params = params
@@ -173,6 +174,12 @@ class QAEngine:
         self.max_question_len = int(max_question_len)
         self.doc_stride = int(doc_stride)
         self._closed = False
+        # the ACTIVE serving precision: callers pass 'int8' when the model/
+        # params pair came through quant.quantize_model (cli/serve.py wires
+        # --quantize straight through); exposed on /metrics and in the
+        # warmup report so an operator can tell at a glance what a replica
+        # is running
+        self.quantize = str(quantize or "off")
 
         # ids-only wire when the vocab fits uint16 (predictor parity — see
         # infer/score.py for the two wire formats)
@@ -242,6 +249,18 @@ class QAEngine:
         self.m_latency_p99 = m.gauge(
             "qa_request_latency_p99_seconds",
             "p99 request latency over recent requests.")
+        self.m_precision = m.info(
+            "qa_active_precision",
+            "Numeric precision of the serving forward (int8 = the "
+            "post-training quantized path, quant/).",
+            {"precision": "int8" if self.quantize == "int8" else "bf16"})
+        self.m_weight_bytes = m.gauge(
+            "qa_weight_bytes",
+            "Resident model parameter bytes (int8 quantization roughly "
+            "quarters the float kernels).")
+        from ..quant.quantize import param_bytes
+
+        self.m_weight_bytes.set(param_bytes(params))
 
         self.batcher = MicroBatcher(
             grid,
@@ -351,10 +370,17 @@ class QAEngine:
         the grid instead of OOMing mid-traffic. Kernel-geometry decisions
         ride the process-wide autotune cache, so a warm restart performs
         zero probes (the report carries the autotuner's session summary)."""
+        from ..quant.quantize import param_bytes
+
         t0 = time.perf_counter()
         report = {
             "buckets": [], "dropped": [], "preflight": {},
             "wire": "ids" if self._wire_ids_only else "3plane",
+            # precision provenance: the pre-flight's memory_analysis below
+            # already sees the ~4x-smaller int8 kernels (bigger buckets
+            # fit), and bench.py surfaces both fields in its JSON line
+            "quantize": self.quantize,
+            "quant_mem_bytes": param_bytes(self.params),
         }
         for bucket in list(self.grid):
             if hbm_preflight:

@@ -86,6 +86,44 @@ class Gauge:
         return [(self.name, self.value)]
 
 
+class Info:
+    """Prometheus info-style metric: constant ``1`` with identifying labels
+    (``name{key="value",...} 1``) — the idiomatic way to expose build/mode
+    facts like the serving plane's active precision without a label-aware
+    metric model. Labels may be replaced wholesale (``set``); values are
+    escaped per the text exposition format."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help_: str, labels: Dict[str, str]):
+        self.name = name
+        self.help = help_
+        self._labels = dict(labels)
+        self._lock = threading.Lock()
+
+    def set(self, **labels: str) -> None:
+        with self._lock:
+            self._labels = dict(labels)
+
+    @property
+    def labels(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._labels)
+
+    @staticmethod
+    def _escape(value: str) -> str:
+        return (str(value).replace("\\", r"\\").replace('"', r"\"")
+                .replace("\n", r"\n"))
+
+    def samples(self) -> List[Tuple[str, float]]:
+        with self._lock:
+            label_str = ",".join(
+                f'{k}="{self._escape(v)}"'
+                for k, v in sorted(self._labels.items())
+            )
+        return [(f"{self.name}{{{label_str}}}", 1.0)]
+
+
 # default latency buckets: 1 ms .. 30 s (request latency on a serving box)
 DEFAULT_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
@@ -200,6 +238,9 @@ class Registry:
     def histogram(self, name: str, help_: str,
                   buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
         return self.register(Histogram(name, help_, buckets))
+
+    def info(self, name: str, help_: str, labels: Dict[str, str]) -> Info:
+        return self.register(Info(name, help_, labels))
 
     def get(self, name: str):
         with self._lock:

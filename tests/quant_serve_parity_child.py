@@ -1,0 +1,90 @@
+"""Subprocess body of test_serve.py::test_quantized_engine_span_parity_with_bf16.
+
+The int8 engine's LIVE submit path runs in this child process, not in the
+tier-1 pytest process: executing the quantized engine's compiled programs
+through the batcher thread inside the long-running suite corrupts the
+process heap on XLA *CPU* (the suite later segfaults/aborts in an
+unrelated test — bisected to exactly this workload; the identical
+workload as its own process, e.g. ``bench.py --mode serve --quantize
+int8``, is clean). Quarantining the execution preserves the e2e
+acceptance coverage — this script builds the SAME deterministic stack the
+parent fixture uses (same vocab, same ``jax.random.key(0)`` init), serves
+one request through a bf16 and an int8 engine, and prints one JSON
+verdict the parent asserts on.
+
+Run: ``python quant_serve_parity_child.py <tmp_dir>`` with a JSON
+``{"question": ..., "document": ...}`` on stdin.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+
+def main(tmp_dir: str) -> int:
+    import jax
+
+    from helpers import make_tokenizer
+    from ml_recipe_tpu.models import EncoderConfig, QAModel
+    from ml_recipe_tpu.parallel import build_mesh
+    from ml_recipe_tpu.quant import param_bytes, quantize_model
+    from ml_recipe_tpu.serve.bucketing import BucketGrid
+    from ml_recipe_tpu.serve.engine import QAEngine
+
+    request = json.loads(sys.stdin.read())
+
+    tok = make_tokenizer(Path(tmp_dir))
+    cfg = EncoderConfig(
+        vocab_size=len(tok), hidden_size=16, num_layers=1, num_heads=2,
+        intermediate_size=32, max_position_embeddings=66, num_labels=5,
+    )
+    model = QAModel(cfg)
+    params = model.init(
+        jax.random.key(0), np.zeros((1, 8), dtype=np.int32)
+    )["params"]
+    qmodel, qparams, report = quantize_model(model, params)
+
+    def serve_one(m, p, quantize):
+        engine = QAEngine(
+            m, p, tok, grid=BucketGrid.from_spec("4x64,8x64"),
+            mesh=build_mesh(), max_batch_delay_ms=5, queue_size=64,
+            max_question_len=16, doc_stride=24, quantize=quantize,
+        )
+        warm = engine.warmup(hbm_preflight=False)
+        try:
+            res = engine.submit(
+                request["question"], request["document"]
+            ).result(timeout=60)
+            metrics = engine.render_metrics()
+        finally:
+            engine.close(timeout=10)
+        return {
+            "warm_quantize": warm["quantize"],
+            "warm_quant_mem_bytes": warm["quant_mem_bytes"],
+            "n_chunks": res.n_chunks,
+            "label": str(res.label),
+            "start": int(res.start),
+            "end": int(res.end),
+            "answer": res.answer,
+            "score": float(res.score),
+            "metrics_precision_line": next(
+                (l for l in metrics.splitlines()
+                 if l.startswith("qa_active_precision")), ""),
+        }
+
+    ref = serve_one(model, params, "off")
+    got = serve_one(qmodel, qparams, "int8")
+    print(json.dumps({
+        "ref": ref,
+        "got": got,
+        "param_bytes": param_bytes(params),
+        "qparam_bytes": param_bytes(qparams),
+        "n_quantized": report["n_quantized"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1]))

@@ -290,3 +290,121 @@ def test_tuning_cache_smoke_end_to_end(tuner):
 
     flash_attention(q, k, v, None, dtype=jnp.float32, interpret=True)
     assert tuner.hits >= 1
+
+
+# ---------------------------------------------------------------------------
+# timing-ranked selection (ROADMAP raw-speed item b): probes that hand back
+# their compiled objects opt into cost_analysis ranking
+# ---------------------------------------------------------------------------
+
+
+class _FakeCompiled:
+    """A compiled-program stand-in exposing XLA's cost_analysis dict."""
+
+    def __init__(self, flops, byts, as_list=False):
+        self._ca = {"flops": float(flops), "bytes accessed": float(byts)}
+        self._as_list = as_list
+
+    def cost_analysis(self):
+        return [self._ca] if self._as_list else self._ca
+
+
+def test_measured_ranking_overrides_prior(tuner, monkeypatch):
+    """When every legal candidate carries a compiled-cost estimate, the
+    winner is the MEASURED-cheapest one even when the analytic prior ranks
+    another first — and the ranking signal persists in the cache JSON."""
+    _fake_tpu(monkeypatch)
+    probed = []
+    # prior order is [12, 6, 4, 2]; measured cost says hc=4 is cheapest
+    est_bytes = {12: 9e9, 6: 6e9, 4: 1e9, 2: 5e9}
+
+    def probe(hc):
+        probed.append(hc)
+        return _FakeCompiled(flops=1e9, byts=est_bytes[hc],
+                             as_list=(hc == 6))  # list-form tolerated
+
+    assert _select(tuner, probe=probe) == 4
+    assert probed == [12, 6, 4, 2]  # ranking probes ALL candidates
+    assert tuner.probe_count == 4
+
+    payload = json.loads(tuner._cache_file("FakeTPU v0").read_text())
+    (entry,) = payload["entries"].values()
+    assert entry["geometry"] == 4
+    assert entry["ranking"] == "measured"
+    assert set(entry["cost_estimates"]) == {"12", "6", "4", "2"}
+    assert entry["cost_estimates"]["4"]["bytes_accessed"] == 1e9
+    assert entry["cost_estimates"]["4"]["est_seconds"] > 0
+
+    # the measured verdict round-trips the disk cache: fresh process, zero
+    # probes, same winner
+    fresh = autotune.GeometryAutotuner(cache_dir=tuner.cache_dir)
+    assert _select(fresh, probe=lambda hc: pytest.fail("probed on hit")) == 4
+    assert fresh.probe_count == 0
+
+
+def test_ranking_probe_failures_are_best_effort(tuner, monkeypatch):
+    """Once a legal winner exists, a ranking probe that raises is skipped
+    (logged), never fatal — the legacy safety contract only covers the walk
+    UP TO the first legal candidate."""
+    _fake_tpu(monkeypatch)
+
+    def probe(hc):
+        if hc == 6:
+            raise RuntimeError("transient probe-environment failure")
+        return _FakeCompiled(flops=1e9, byts={12: 2e9, 4: 8e9, 2: 9e9}[hc])
+
+    assert _select(tuner, probe=probe) == 12  # measured-cheapest survivor
+    entry = list(tuner._entries["FakeTPU v0"].values())[0]
+    assert entry["ranking"] == "measured"
+    assert set(entry["cost_estimates"]) == {"12", "4", "2"}
+
+
+def test_bool_probes_keep_first_legal_contract(tuner, monkeypatch):
+    """A probe returning bare True (no compiled object) keeps the legacy
+    first-legal-wins semantics: the walk stops, no ranking keys appear in
+    the cache entry."""
+    _fake_tpu(monkeypatch)
+    probed = []
+    assert _select(tuner, probe=lambda hc: probed.append(hc) or True) == 12
+    assert probed == [12]
+    (entry,) = tuner._entries["FakeTPU v0"].values()
+    assert entry == {"geometry": 12, "source": "probe"}
+
+
+def test_estimate_extraction_is_best_effort(tuner, monkeypatch):
+    """A compiled object whose cost_analysis raises or reports nothing
+    degrades to first-legal-wins instead of crashing the selection."""
+    _fake_tpu(monkeypatch)
+
+    class _Broken:
+        def cost_analysis(self):
+            raise RuntimeError("not supported on this backend")
+
+    probed = []
+    assert _select(tuner, probe=lambda hc: probed.append(hc) or _Broken()
+                   ) == 12
+    assert probed == [12]  # no estimate -> stop at first legal
+    assert autotune._cost_estimate(_Broken()) is None
+    assert autotune._cost_estimate(object()) is None
+    assert autotune._cost_estimate(
+        _FakeCompiled(flops=0.0, byts=0.0)) is None
+
+
+def test_combine_for_ranking_sums_legs():
+    """Multi-program candidates (streaming fwd + dkv) rank by the SUM of
+    their legs' estimates; any falsy leg fails the candidate and any
+    estimate-less leg withdraws the estimate (prior ranking then applies)."""
+    a = _FakeCompiled(flops=1e9, byts=2e9)
+    b = _FakeCompiled(flops=3e9, byts=4e9, as_list=True)
+    combined = autotune.combine_for_ranking(a, b)
+    est = autotune._cost_estimate(combined)
+    assert est["flops"] == 4e9 and est["bytes_accessed"] == 6e9
+
+    assert autotune.combine_for_ranking(a, False) is False
+    assert autotune.combine_for_ranking() is False
+
+    class _NoCost:
+        pass
+
+    assert autotune._cost_estimate(
+        autotune.combine_for_ranking(a, _NoCost())) is None

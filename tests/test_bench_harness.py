@@ -269,6 +269,12 @@ def test_bench_serve_emits_closed_loop_latency_json(bench, capsys):
     assert 0 <= parsed["padding_waste_mean"] < 1
     assert parsed["buckets"] == ["4x64"]
     assert parsed["autotune_probes"] == 0
+    # ISSUE-6: the precision provenance fields ride every serve JSON line
+    # (off by default; args without the attr mean off too)
+    assert parsed["quantize"] == "off"
+    assert parsed["quant_mem_bytes"] is None
+    assert parsed["parity_span_agreement"] is None
+    assert parsed["parity_score_max_delta"] is None
 
 
 def test_bench_input_packed_pass_pins_waste_reduction(bench, capsys):

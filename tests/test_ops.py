@@ -637,6 +637,7 @@ def test_fused_bwd_hc_unclassified_error_falls_back_to_conservative(
                 raise RuntimeError(
                     "mosaic lowering error: some future overflow wording"
                 )
+            return self  # probes hand back the compiled object (ranking)
 
     class _FakeJitted:
         def __init__(self, hc):

@@ -511,3 +511,48 @@ def test_predictor_packing_supersedes_length_buckets(corpus_setup, caplog):
         )
     assert p._packing and p._seq_grid is None
     assert "supersedes length_buckets" in caplog.text
+
+
+def test_quantized_predictor_span_parity_with_bf16(corpus_setup):
+    """ISSUE-6 satellite: the int8 predictor agrees with the bf16 one on
+    the synthetic NQ fixture — chunk-level span parity through the shared
+    scoring forward, and document-level candidate parity end to end."""
+    from ml_recipe_tpu.quant import quantize_model, span_parity
+
+    tok, val_dataset, _ = corpus_setup
+    model, params = _tiny_model(tok)
+    qmodel, qparams, report = quantize_model(model, params)
+    assert report["n_quantized"] == 11
+
+    # chunk-level: identical collated inputs through both scoring paths
+    collate = init_collate_fun(tok, max_seq_len=64, return_items=True)
+    chunks = [c for i in range(len(val_dataset)) for c in val_dataset[i]]
+    batches = [
+        collate(chunks[at: at + 8])[0]
+        for at in range(0, min(len(chunks), 32), 8)
+    ]
+    parity = span_parity(model, params, qmodel, qparams, batches)
+    assert parity["n_chunks"] >= 1
+    assert parity["span_agreement"] >= 0.9, parity
+    assert parity["label_agreement"] >= 0.9, parity
+    assert parity["score_max_abs_delta"] < 0.25, parity
+
+    # document-level: the quantized Predictor runs the whole pipeline and
+    # lands the same candidate documents as the float one
+    def run(m, p):
+        predictor = Predictor(
+            m, p, mesh=build_mesh("data:1"), collate_fun=collate,
+            batch_size=8, n_jobs=2,
+        )
+        predictor(val_dataset)
+        return predictor
+
+    ref, got = run(model, params), run(qmodel, qparams)
+    assert set(got.candidates) == set(ref.candidates)
+    same_span = [
+        got.candidates[d].start_id == ref.candidates[d].start_id
+        and got.candidates[d].end_id == ref.candidates[d].end_id
+        for d in ref.candidates
+    ]
+    if same_span:  # random-init winners exist on this fixture
+        assert np.mean(same_span) >= 0.9

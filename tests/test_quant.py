@@ -1,0 +1,434 @@
+"""Int8 quantization subsystem tests (ml_recipe_tpu/quant/ + ops/quant_matmul).
+
+Tier-1 coverage of the ISSUE-6 acceptance surface on CPU:
+quant/dequant round-trip exactness (interpret-mode arithmetic is the
+arithmetic hardware runs), per-channel scale correctness, Pallas-kernel vs
+XLA-emulation bit parity, autotune ``-q8`` cache-key isolation, the
+mocked-HBM predict pre-flight seeing the smaller quantized weight
+residency, end-to-end span parity vs the bf16 path on the synthetic NQ
+fixture, and ``quantize='off'`` bit-identity with the historical model.
+"""
+
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ml_recipe_tpu.models import EncoderConfig, QAModel
+from ml_recipe_tpu.ops import autotune
+from ml_recipe_tpu.ops.quant_matmul import (
+    INT8_MAX,
+    _build_q8_call,
+    _q8_analytic,
+    _q8_candidates,
+    int8_matmul,
+    quantize_rowwise,
+    supports_q8_kernel,
+)
+from ml_recipe_tpu.quant import (
+    make_parity_batches,
+    param_bytes,
+    quantize_kernel,
+    quantize_model,
+    quantize_params,
+    span_parity,
+    weight_kernel_bytes,
+)
+
+from helpers import make_tokenizer, nq_line
+
+pytestmark = pytest.mark.unit
+
+
+@pytest.fixture(autouse=True)
+def _fresh_autotuner(tmp_path):
+    """Per-test autotuner on a tmp cache dir: q8 selections must not leak
+    into (or read from) the repo's artifacts/tuning."""
+    at = autotune.reset()
+    at.set_cache_dir(tmp_path / "tuning")
+    yield at
+    autotune.reset()
+
+
+# ---------------------------------------------------------------------------
+# weight quantization grid
+# ---------------------------------------------------------------------------
+
+
+def test_quantize_kernel_round_trip_exact_on_grid():
+    """Weights already ON the int8 grid survive quantization exactly —
+    quant(dequant(q)) is the identity there, so the error the report
+    measures is purely off-grid rounding."""
+    rng = np.random.default_rng(0)
+    scale = rng.uniform(1e-3, 2e-2, size=(8,)).astype(np.float32)
+    q_true = rng.integers(-127, 128, size=(16, 8)).astype(np.float32)
+    # force the per-column amax onto the grid end so scale reproduces
+    q_true[0, :] = 127.0
+    w = q_true * scale[None, :]
+    q, s = quantize_kernel(w)
+    assert q.dtype == np.int8 and s.dtype == np.float32
+    np.testing.assert_allclose(s, scale, rtol=1e-6)
+    np.testing.assert_array_equal(q.astype(np.float32), q_true)
+    np.testing.assert_allclose(q.astype(np.float32) * s[None, :], w,
+                               rtol=1e-6)
+
+
+def test_quantize_kernel_per_channel_scales_and_error_bound():
+    rng = np.random.default_rng(1)
+    w = rng.normal(size=(64, 12)).astype(np.float32)
+    q, s = quantize_kernel(w)
+    np.testing.assert_allclose(
+        s, np.max(np.abs(w), axis=0) / INT8_MAX, rtol=1e-6
+    )
+    err = np.abs(q.astype(np.float32) * s[None, :] - w)
+    # round-to-nearest: per-element error is at most half a step per channel
+    assert np.all(err <= s[None, :] * 0.5 + 1e-7)
+    # an all-zero column must not divide by zero and must quantize to zeros
+    w[:, 3] = 0.0
+    q2, s2 = quantize_kernel(w)
+    assert np.all(np.isfinite(s2)) and np.all(q2[:, 3] == 0)
+    with pytest.raises(ValueError):
+        quantize_kernel(np.zeros((4,), np.float32))
+
+
+def test_quantize_rowwise_grid_and_zero_rows():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(4, 32)).astype(np.float32))
+    x = x.at[2].set(0.0)  # an all-pad row must stay finite
+    q, s = quantize_rowwise(x)
+    assert q.dtype == jnp.int8 and s.shape == (4, 1)
+    qn, sn = np.asarray(q, np.float32), np.asarray(s)
+    assert np.all(np.isfinite(sn)) and np.all(qn[2] == 0)
+    err = np.abs(qn * sn - np.asarray(x))
+    assert np.all(err <= sn * 0.5 + 1e-7)
+    # the max-abs element hits the grid end exactly
+    assert np.max(np.abs(qn)) == 127.0
+
+
+# ---------------------------------------------------------------------------
+# int8 matmul: exact accumulation, kernel/emulation parity
+# ---------------------------------------------------------------------------
+
+
+def test_int8_matmul_emulation_is_exact_integer_accumulation():
+    """The contraction is EXACT int32 math: against a numpy int reference
+    the only arithmetic left is the final f32 rescale."""
+    rng = np.random.default_rng(3)
+    xq = rng.integers(-127, 128, size=(8, 64)).astype(np.int8)
+    wq = rng.integers(-127, 128, size=(64, 16)).astype(np.int8)
+    xs = rng.uniform(1e-3, 1e-1, size=(8, 1)).astype(np.float32)
+    ws = rng.uniform(1e-3, 1e-1, size=(16,)).astype(np.float32)
+    got = np.asarray(int8_matmul(
+        jnp.asarray(xq), jnp.asarray(xs), jnp.asarray(wq), jnp.asarray(ws),
+        impl="emulate",
+    ))
+    acc = xq.astype(np.int32) @ wq.astype(np.int32)
+    ref = acc.astype(np.float32) * xs * ws[None, :]
+    np.testing.assert_array_equal(got, ref)
+
+
+@pytest.mark.parametrize("M,K,N", [(64, 128, 256), (32, 128, 128),
+                                   (96, 256, 128)])
+def test_pallas_kernel_bit_parity_with_emulation(M, K, N):
+    """Interpret-mode Pallas kernel vs XLA emulation: BIT-identical — CPU
+    tier-1 pins the exact quant/dequant arithmetic the TPU kernel runs."""
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(M, K)).astype(np.float32))
+    wq, ws = quantize_kernel(rng.normal(size=(K, N)).astype(np.float32))
+    xq, xs = quantize_rowwise(x)
+    a = int8_matmul(xq, xs, jnp.asarray(wq), jnp.asarray(ws), impl="emulate")
+    b = int8_matmul(xq, xs, jnp.asarray(wq), jnp.asarray(ws), impl="pallas",
+                    interpret=True)
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_q8_kernel_geometry_grid_sweep_bit_parity():
+    """Every candidate block geometry computes the same answer (a geometry
+    that changed results would make autotune picks visible in outputs)."""
+    M, K, N = 64, 128, 256
+    rng = np.random.default_rng(5)
+    xq = jnp.asarray(rng.integers(-127, 128, size=(M, K)).astype(np.int8))
+    xs = jnp.asarray(rng.uniform(1e-3, 1e-1, (M, 1)).astype(np.float32))
+    wq = jnp.asarray(rng.integers(-127, 128, size=(K, N)).astype(np.int8))
+    ws = jnp.asarray(rng.uniform(1e-3, 1e-1, (1, N)).astype(np.float32))
+    # interpret-mode calls take int32 operand planes (same [-127, 127]
+    # values — the _q8_operand_dtype heap-corruption dodge in quant_matmul)
+    xq32, wq32 = xq.astype(jnp.int32), wq.astype(jnp.int32)
+    outs = [
+        np.asarray(_build_q8_call(M, K, N, bm, bn, interpret=True)(
+            xq32, xs, wq32, ws))
+        for bm, bn in _q8_candidates(M, N)
+    ]
+    assert len(outs) >= 2  # the sweep must actually sweep
+    for out in outs[1:]:
+        assert np.array_equal(outs[0], out)
+
+
+def test_supports_q8_kernel_alignment_rules():
+    assert supports_q8_kernel(64, 128, 256)
+    assert not supports_q8_kernel(64, 128, 5)     # QA-head N
+    assert not supports_q8_kernel(64, 100, 256)   # unaligned K
+    assert not supports_q8_kernel(7, 128, 256)    # unaligned rows
+    # unsupported shapes still COMPUTE (emulation), with exact arithmetic
+    rng = np.random.default_rng(6)
+    x = jnp.asarray(rng.normal(size=(3, 20)).astype(np.float32))
+    wq, ws = quantize_kernel(rng.normal(size=(20, 2)).astype(np.float32))
+    xq, xs = quantize_rowwise(x)
+    out = int8_matmul(xq, xs, jnp.asarray(wq), jnp.asarray(ws), impl="auto")
+    assert out.shape == (3, 2) and np.all(np.isfinite(np.asarray(out)))
+
+
+def test_q8_analytic_pick_is_legal():
+    geom = _q8_analytic(512, 768, 768)
+    assert geom is not None
+    bm, bn = geom
+    assert 512 % bm == 0 and 768 % bn == 0
+
+
+# ---------------------------------------------------------------------------
+# autotune -q8 key isolation
+# ---------------------------------------------------------------------------
+
+
+def test_q8_cache_keys_are_isolated(_fresh_autotuner):
+    """Quantized-matmul geometry decisions live under distinct ``q8``
+    suffixed keys — they can never collide with an attention kernel's
+    entry for the same (L, H, D) slot."""
+    key_plain = autotune.GeometryAutotuner.make_key(
+        "fused_fwd", batch=1, L=512, H=768, D=768,
+        in_dtype="bfloat16", out_dtype="bfloat16", dropout=False)
+    key_q8 = autotune.GeometryAutotuner.make_key(
+        "q8_matmul", batch=1, L=512, H=768, D=768,
+        in_dtype="int8", out_dtype="float32", dropout=False, extra="q8")
+    assert key_plain != key_q8 and key_q8.endswith("|q8")
+
+    # driving the real kernel path records a q8-keyed decision
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(64, 128)).astype(np.float32))
+    wq, ws = quantize_kernel(rng.normal(size=(128, 128)).astype(np.float32))
+    xq, xs = quantize_rowwise(x)
+    int8_matmul(xq, xs, jnp.asarray(wq), jnp.asarray(ws), impl="pallas",
+                interpret=True)
+    decisions = _fresh_autotuner.session_summary()["decisions"]
+    assert any(k.startswith("q8_matmul|") and k.endswith("|q8")
+               for k in decisions), decisions
+    # CPU/interpret selection is analytic — zero compile probes (the warm
+    # serving restart acceptance: no probes off-TPU, cache hits on-TPU)
+    assert _fresh_autotuner.probe_count == 0
+
+
+# ---------------------------------------------------------------------------
+# parameter-tree conversion
+# ---------------------------------------------------------------------------
+
+
+def _tiny_model(vocab=64, max_len=66):
+    cfg = EncoderConfig(
+        vocab_size=vocab, hidden_size=16, num_layers=1, num_heads=2,
+        intermediate_size=32, max_position_embeddings=max_len, num_labels=5,
+    )
+    model = QAModel(cfg)
+    params = model.init(
+        jax.random.key(0), np.zeros((1, 8), dtype=np.int32)
+    )["params"]
+    return model, params
+
+
+def test_quantize_params_converts_kernels_only():
+    model, params = _tiny_model()
+    qparams, report = quantize_params(params)
+
+    # every 2D kernel converted: QKV + attn out + FFN pair + pooler + heads
+    # (position_outputs, classifier, reg_start, reg_end) = 11 for 1 layer
+    assert report["n_quantized"] == 11
+    assert len(report["layers"]) == 11
+    for layer in report["layers"]:
+        assert layer["rel_rms_err"] < 0.02  # per-layer error is reported
+
+    attn = qparams["transformer"]["layer_0"]["attention"]["query"]
+    assert set(attn) == {"kernel_q", "kernel_scale", "bias"}
+    assert np.asarray(attn["kernel_q"]).dtype == np.int8
+    # non-kernel leaves pass through BY REFERENCE (embeddings, LN, biases)
+    emb = params["transformer"]["embeddings"]["word_embeddings"]["embedding"]
+    assert qparams["transformer"]["embeddings"]["word_embeddings"][
+        "embedding"] is emb
+
+    # byte accounting: the kernel residency shrinks to ~1/4 (+scales)
+    assert report["quant_bytes"] < report["orig_bytes"]
+    assert report["quant_kernel_bytes"] < 0.3 * report["orig_kernel_bytes"]
+    assert param_bytes(qparams) == report["quant_bytes"]
+    assert weight_kernel_bytes(params) == report["orig_kernel_bytes"]
+
+
+def test_quantize_model_modes():
+    model, params = _tiny_model()
+    m2, p2, rep = quantize_model(model, params, "off")
+    assert m2 is model and p2 is params and rep == {"quantize": "off"}
+    qmodel, qparams, rep = quantize_model(model, params)
+    assert qmodel.quantize == "int8" and rep["quantize"] == "int8"
+    with pytest.raises(ValueError):
+        quantize_model(model, params, "int4")
+
+
+def test_quantize_off_is_bit_identical():
+    """Acceptance: the default path is untouched — same param tree, same
+    outputs, bit for bit."""
+    model, params = _tiny_model()
+    off = QAModel(model.cfg, quantize="off")
+    ids = np.random.default_rng(8).integers(1, 64, (2, 8)).astype(np.int32)
+    assert jax.tree_util.tree_structure(
+        off.init(jax.random.key(0), ids)["params"]
+    ) == jax.tree_util.tree_structure(params)
+    out = model.apply({"params": params}, ids, deterministic=True)
+    out_off = off.apply({"params": params}, ids, deterministic=True)
+    for k in out:
+        assert np.array_equal(np.asarray(out[k]), np.asarray(out_off[k])), k
+    with pytest.raises(ValueError):
+        QAModel(model.cfg, quantize="int4").apply(
+            {"params": params}, ids, deterministic=True)
+
+
+def test_quantized_model_forward_close_to_float():
+    model, params = _tiny_model()
+    qmodel, qparams, _ = quantize_model(model, params)
+    ids = np.random.default_rng(9).integers(1, 64, (2, 8)).astype(np.int32)
+    out = model.apply({"params": params}, ids, deterministic=True)
+    qout = qmodel.apply({"params": qparams}, ids, deterministic=True)
+    for k in out:
+        a = np.asarray(out[k], np.float32)
+        b = np.asarray(qout[k], np.float32)
+        m = np.abs(a) < 1e8  # skip -inf'd masked span logits
+        assert np.max(np.abs(a[m] - b[m])) < 0.1, k
+
+
+# ---------------------------------------------------------------------------
+# end-to-end span parity on the synthetic NQ fixture (acceptance pin)
+# ---------------------------------------------------------------------------
+
+
+def test_span_parity_on_synthetic_nq_fixture(tmp_path):
+    """Acceptance: the quantized scoring path's span predictions agree with
+    bf16 within the pinned tolerance on the synthetic NQ fixture."""
+    tok = make_tokenizer(tmp_path)
+    cfg = EncoderConfig(
+        vocab_size=len(tok), hidden_size=16, num_layers=1, num_heads=2,
+        intermediate_size=32, max_position_embeddings=66, num_labels=5,
+    )
+    model = QAModel(cfg)
+    params = model.init(
+        jax.random.key(0), np.zeros((1, 8), dtype=np.int32)
+    )["params"]
+    qmodel, qparams, _ = quantize_model(model, params)
+
+    lines = [nq_line(example_id=str(i)) for i in range(4)]
+    batches = make_parity_batches(
+        tok, lines, max_seq_len=64, max_question_len=16, doc_stride=24,
+        batch_size=4,
+    )
+    assert batches and all(b["input_ids"].shape == (4, 64) for b in batches)
+    report = span_parity(model, params, qmodel, qparams, batches)
+    assert report["n_chunks"] >= 4
+    # pinned tolerance: spans and labels must agree on at least 90% of
+    # chunks and the answerability score must not drift past 0.25
+    assert report["span_agreement"] >= 0.9, report
+    assert report["label_agreement"] >= 0.9, report
+    assert report["score_max_abs_delta"] < 0.25, report
+
+
+# ---------------------------------------------------------------------------
+# serving pre-flight sees the quantized weight residency
+# ---------------------------------------------------------------------------
+
+
+def test_predict_preflight_accounts_quantized_weight_bytes(tmp_path):
+    """Mocked-HBM pre-flight: at a device limit between the float and the
+    int8 weight residency, the bf16 engine's bucket does NOT fit and the
+    quantized engine's does — the ~4x smaller kernels buy bigger feasible
+    buckets, per the conversion report's byte accounting."""
+    from ml_recipe_tpu.parallel import build_mesh
+    from ml_recipe_tpu.serve.bucketing import Bucket, BucketGrid
+    from ml_recipe_tpu.serve.engine import QAEngine
+
+    tok = make_tokenizer(tmp_path)
+    cfg = EncoderConfig(
+        vocab_size=len(tok), hidden_size=32, num_layers=2, num_heads=2,
+        intermediate_size=128, max_position_embeddings=66, num_labels=5,
+    )
+    model = QAModel(cfg)
+    params = model.init(
+        jax.random.key(0), np.zeros((1, 8), dtype=np.int32)
+    )["params"]
+    qmodel, qparams, report = quantize_model(model, params)
+    assert report["quant_bytes"] < report["orig_bytes"]
+
+    mesh = build_mesh()
+    grid = BucketGrid.from_spec("2x64")
+    engines = {
+        "bf16": QAEngine(model, params, tok, grid=grid, mesh=mesh),
+        "int8": QAEngine(
+            qmodel, qparams, tok, grid=BucketGrid.from_spec("2x64"),
+            mesh=mesh, quantize="int8"),
+    }
+
+    activations = 1 << 16  # same per-bucket activation footprint for both
+
+    def compile_fn_for(engine):
+        # the projected step bytes are weights + activations — exactly the
+        # quantity memory_analysis reports on hardware, derived here from
+        # the engine's OWN param tree so the verdict tracks precision
+        def compile_fn(bucket):
+            return SimpleNamespace(memory_analysis=lambda: SimpleNamespace(
+                argument_size_in_bytes=param_bytes(engine.params),
+                output_size_in_bytes=0,
+                temp_size_in_bytes=activations,
+                alias_size_in_bytes=0,
+            ))
+        return compile_fn
+
+    limit = (report["quant_bytes"] + report["orig_bytes"]) // 2 + activations
+    verdicts = {
+        name: eng.preflight_predict_step(
+            Bucket(seq=64, batch=2), limit_bytes=limit,
+            compile_fn=compile_fn_for(eng),
+        )
+        for name, eng in engines.items()
+    }
+    assert verdicts["bf16"]["fits"] is False
+    assert verdicts["int8"]["fits"] is True
+    assert verdicts["int8"]["bytes"] < verdicts["bf16"]["bytes"]
+    for eng in engines.values():
+        eng.close(timeout=5)
+
+
+def test_engine_metrics_expose_active_precision(tmp_path):
+    """/metrics labels the serving precision (Info metric) and the resident
+    weight bytes for both precisions."""
+    from ml_recipe_tpu.parallel import build_mesh
+    from ml_recipe_tpu.serve.bucketing import BucketGrid
+    from ml_recipe_tpu.serve.engine import QAEngine
+
+    tok = make_tokenizer(tmp_path)
+    model, params = _tiny_model(vocab=len(tok))
+    qmodel, qparams, _ = quantize_model(model, params)
+    mesh = build_mesh()
+
+    eng = QAEngine(model, params, tok, grid=BucketGrid.from_spec("2x64"),
+                   mesh=mesh)
+    try:
+        text = eng.render_metrics()
+        assert 'qa_active_precision{precision="bf16"} 1' in text
+        assert f"qa_weight_bytes {param_bytes(params)}" in text
+    finally:
+        eng.close(timeout=5)
+
+    qeng = QAEngine(qmodel, qparams, tok, grid=BucketGrid.from_spec("2x64"),
+                    mesh=mesh, quantize="int8")
+    try:
+        text = qeng.render_metrics()
+        assert 'qa_active_precision{precision="int8"} 1' in text
+        assert f"qa_weight_bytes {param_bytes(qparams)}" in text
+    finally:
+        qeng.close(timeout=5)

@@ -800,3 +800,67 @@ def test_bare_except_gate_covers_serve_package():
     }
     script = (_REPO / "scripts" / "check_bare_except.sh").read_text()
     assert "ml_recipe_tpu/" in script and "-r" in script
+
+
+def test_quantized_engine_span_parity_with_bf16(tmp_path):
+    """ISSUE-6 acceptance: an int8 engine (quant.quantize_model conversion
+    at startup) serves the same spans as the bf16 engine for the same
+    request, within the pinned score tolerance; its warmup report and
+    /metrics label the active precision and the smaller weight residency.
+
+    The live engines run in a SUBPROCESS (quant_serve_parity_child.py):
+    executing the quantized engine's compiled programs through the batcher
+    thread inside the long tier-1 process corrupts the heap on XLA CPU
+    (the suite later segfaults in an unrelated test — bisected to exactly
+    this workload; the same workload as its own process is clean). The
+    child builds the same deterministic stack and reports a JSON verdict."""
+    import os
+    import subprocess
+    import sys
+
+    child = Path(__file__).parent / "quant_serve_parity_child.py"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [str(_REPO), str(Path(__file__).parent)]
+        + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+    )
+    proc = subprocess.run(
+        [sys.executable, str(child), str(tmp_path)],
+        input=json.dumps({"question": _QUESTION, "document": _DOCUMENT}),
+        capture_output=True, text=True, timeout=420,
+        cwd=str(Path(__file__).parent), env=env,
+    )
+    assert proc.returncode == 0, (
+        f"parity child failed ({proc.returncode}):\n{proc.stderr[-4000:]}"
+    )
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    ref, got = verdict["ref"], verdict["got"]
+
+    assert verdict["n_quantized"] == 11  # QKV/out/2xFFN/pooler + 5 heads
+    assert got["warm_quantize"] == "int8"
+    assert got["warm_quant_mem_bytes"] == verdict["qparam_bytes"]
+    assert got["warm_quant_mem_bytes"] < verdict["param_bytes"]
+
+    assert got["n_chunks"] == ref["n_chunks"]
+    assert got["label"] == ref["label"]
+    assert got["start"] == ref["start"] and got["end"] == ref["end"]
+    assert got["answer"] == ref["answer"]
+    assert abs(got["score"] - ref["score"]) < 0.25
+
+    assert got["metrics_precision_line"] == (
+        'qa_active_precision{precision="int8"} 1')
+    # the bf16 engine labels ITS precision too (default path)
+    assert ref["metrics_precision_line"] == (
+        'qa_active_precision{precision="bf16"} 1')
+
+
+def test_serve_parser_default_quantize_off(tmp_path):
+    """--quantize defaults off (the historical bf16 engine, bit-identical)
+    and the example config documents the flag."""
+    import sys
+    from unittest import mock
+
+    with mock.patch.object(sys, "argv", ["serve"]):
+        params, _ = get_params((get_serve_parser, get_model_parser))[1]
+    assert params.quantize == "off"
+    assert "quantize" in (_REPO / "config" / "serve.cfg").read_text()
