@@ -58,6 +58,8 @@ def main(params, model_params) -> int:
         max_question_len=params.max_question_len,
         doc_stride=params.doc_stride,
         quantize=getattr(params, "quantize", "off"),
+        serve_cache_bytes=getattr(params, "serve_cache_bytes", 0),
+        doc_cache_bytes=getattr(params, "doc_cache_bytes", 0),
     )
     engine.warmup(hbm_preflight=params.hbm_preflight)
 

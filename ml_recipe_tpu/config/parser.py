@@ -57,6 +57,16 @@ def cast_prefetch(value):
     return int(value)
 
 
+def cast_bytes(value) -> int:
+    """Byte-budget domain for the serving caches: a plain int, or a
+    human-friendly K/M/G(iB) suffix ('64M', '1g'). 0 disables."""
+    text = str(value).strip().lower()
+    for suffix, mult in (("k", 1 << 10), ("m", 1 << 20), ("g", 1 << 30)):
+        if text.endswith(suffix):
+            return int(float(text[:-1]) * mult)
+    return int(text)
+
+
 def cast_loss_scale(value: str):
     """'None' -> None, 'dynamic' -> 'dynamic', anything else -> float
     (mirrors apex's loss_scale flag domain)."""
@@ -693,6 +703,26 @@ def get_serve_parser() -> ConfigArgumentParser:
                              "warmup: memory_analysis each bucket program "
                              "and DROP buckets that exceed device HBM "
                              "instead of OOMing mid-traffic.")
+    parser.add_argument("--serve_cache_bytes", type=cast_bytes, default=0,
+                        help="Tier-2 chunk-result cache byte budget "
+                             "(serve/cache.py; plain bytes or K/M/G "
+                             "suffix). Caches the packed span-logit row of "
+                             "each exact device input row, keyed by a hash "
+                             "of the assembled row + the checkpoint "
+                             "fingerprint + the active precision, with "
+                             "single-flight dedup of identical in-flight "
+                             "chunks — repeated (question, document) "
+                             "traffic bypasses the device entirely. 0 "
+                             "(default) disables the tier; cached and "
+                             "uncached responses are bit-identical.")
+    parser.add_argument("--doc_cache_bytes", type=cast_bytes, default=0,
+                        help="Tier-1 document-preprocessing cache byte "
+                             "budget (serve/cache.py; plain bytes or K/M/G "
+                             "suffix). Caches encode_document tokens and "
+                             "the window_chunks layout keyed by document "
+                             "content hash, so hot documents skip host "
+                             "tokenization entirely. 0 (default) disables "
+                             "the tier.")
     parser.add_argument("--quantize", type=str, default="off",
                         choices=["off", "int8"],
                         help="Serving precision: 'int8' converts the float "

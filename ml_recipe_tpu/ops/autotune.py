@@ -152,6 +152,13 @@ def _cost_estimate(compiled) -> Optional[dict]:
     }
 
 
+def program_cost_estimate(compiled) -> Optional[dict]:
+    """Public face of ``_cost_estimate`` for whole compiled PROGRAMS (the
+    serving engine estimates each bucket program at warmup and persists the
+    verdict via ``record_cost``)."""
+    return _cost_estimate(compiled)
+
+
 def _geom_json_key(geometry) -> str:
     """Stable JSON-object key for one candidate geometry."""
     if isinstance(geometry, (list, tuple)):
@@ -498,6 +505,47 @@ class GeometryAutotuner:
             )
             return winner, "measured", estimates
         return legal[0], "prior", estimates
+
+    # -- whole-program step-cost estimates (serving flush ranking) -------------
+    #
+    # The serving engine records one ``cost_analysis()`` estimate per bucket
+    # PROGRAM (not per kernel candidate) under a namespaced key, so the
+    # micro-batcher can rank deadline flushes by measured step cost
+    # (ROADMAP serving front (d)) and a warm restart gets the ranking
+    # without compiling. These ride the same per-device-kind JSON files,
+    # version/toolchain checks, and merge-before-write discipline as the
+    # geometry entries; they never touch the probe/hit counters (zero-probe
+    # warm-restart guarantees are unaffected).
+
+    def record_cost(self, key: str, est: dict) -> None:
+        """Persist one whole-program cost estimate (``_cost_estimate``
+        shape: flops / bytes_accessed / est_seconds) under ``key``."""
+        if not self.enabled:
+            return
+        with self._lock:
+            kind = _device_kind()
+            self._load(kind)
+            self._entries.setdefault(kind, {})[key] = {
+                "geometry": None,
+                "source": "cost",
+                "cost_estimates": {"program": dict(est)},
+            }
+            self._persist(kind)
+
+    def lookup_cost(self, key: str) -> Optional[dict]:
+        """The persisted whole-program estimate for ``key``, or None."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            kind = _device_kind()
+            self._load(kind)
+            ent = self._entries.get(kind, {}).get(key)
+            if not isinstance(ent, dict):
+                return None
+            est = (ent.get("cost_estimates") or {}).get("program")
+            if not isinstance(est, dict) or "est_seconds" not in est:
+                return None
+            return dict(est)
 
     # -- session provenance (bench JSON) --------------------------------------
 

@@ -71,12 +71,30 @@ class MicroBatcher:
         queue_size: int = 256,
         fail_fn: Optional[Callable[[Sequence[ChunkWork], BaseException], None]] = None,
         on_depth: Optional[Callable[[int], None]] = None,
+        flush_cost_fn: Optional[Callable[[int, int], Optional[float]]] = None,
     ):
         self.grid = grid
         self._run_fn = run_fn
         self._fail_fn = fail_fn
         self._on_depth = on_depth
+        # measured per-bucket admission (ROADMAP serving front (d)): when
+        # several seqs are deadline-expired at once, ``flush_cost_fn(seq,
+        # n_items)`` returns the estimated step cost of the program that
+        # would launch (the engine backs it with the autotune cache's
+        # persisted ``cost_analysis()`` estimates) and the CHEAPEST flushes
+        # first — small fast programs stop queueing behind expensive ones.
+        # Returns None (or the hook is None) -> historical order (see
+        # ``_rank_flush``). Under sustained cheap-bucket saturation the
+        # cheap queue re-expires every loop iteration, so cost ranking
+        # alone would starve an expensive bucket indefinitely: once any
+        # eligible seq's oldest item has waited ``_starve_after_s``,
+        # fairness overrides cost and the oldest flushes next.
+        self._flush_cost_fn = flush_cost_fn
         self.max_batch_delay_s = max(0.0, float(max_batch_delay_ms)) / 1e3
+        # starvation bound for cost-ranked flushing: several deadlines of
+        # grace for the ranking to earn its occupancy, floored so a 0 ms
+        # deadline doesn't degenerate to pure oldest-first
+        self._starve_after_s = max(8.0 * self.max_batch_delay_s, 0.05)
         self.queue_size = int(queue_size)
 
         self._pending: Dict[int, deque] = {}
@@ -116,6 +134,25 @@ class MicroBatcher:
         with self._cv:
             return self._n_pending
 
+    def precheck(self, *, check_full: bool = True) -> None:
+        """Cheap fast-fail BEFORE the caller pays host-side tokenization:
+        raises :class:`DrainingError`/:class:`QueueFullError` when no
+        request could possibly be admitted right now (draining, or the
+        queue has zero free slots). NOT authoritative — ``submit_many``
+        re-checks all-or-nothing under the lock; this only keeps a
+        saturated server from burning CPU chunking documents it is about
+        to 429 anyway. ``check_full=False`` skips the queue-full arm: with
+        the chunk-result cache enabled a request may need ZERO queue slots
+        (fully hot), so "queue full" no longer implies "will 429"."""
+        with self._cv:
+            if self._draining or self._stopped:
+                raise DrainingError("batcher is draining; not accepting work")
+            if check_full and self._n_pending >= self.queue_size:
+                raise QueueFullError(
+                    f"work queue full ({self._n_pending}/{self.queue_size} "
+                    f"queued)"
+                )
+
     # -- worker ----------------------------------------------------------------
 
     def start(self) -> None:
@@ -140,18 +177,56 @@ class MicroBatcher:
                 oldest, pick = q[0].enqueued_at, seq
         return pick
 
+    def _eligible_seqs(self) -> list:
+        """Seqs allowed to flush right now: all non-empty while draining,
+        otherwise those whose oldest item has aged past the deadline."""
+        if self._draining:
+            return [s for s, q in self._pending.items() if q]
+        now = time.monotonic()
+        return [
+            s for s, q in self._pending.items()
+            if q and now - q[0].enqueued_at >= self.max_batch_delay_s
+        ]
+
+    def _rank_flush(self, eligible: list) -> int:
+        """Which deadline-expired seq flushes first.
+
+        With a ``flush_cost_fn``: cheapest measured step cost first, seqs
+        without an estimate after the measured ones in ascending-seq order
+        (the documented fallback) — UNLESS some eligible item has already
+        waited past the starvation bound, in which case the oldest flushes
+        (cost ranking must trade latency ORDER, never bounded service).
+        Without the hook, or when NO eligible seq has an estimate (a
+        toolchain whose cost_analysis yields nothing must not reorder
+        flushes on no evidence): the historical oldest-item-first order.
+        """
+        oldest = min(eligible, key=lambda s: self._pending[s][0].enqueued_at)
+        if self._flush_cost_fn is None:
+            return oldest
+        waited = time.monotonic() - self._pending[oldest][0].enqueued_at
+        if waited >= self._starve_after_s:
+            return oldest
+
+        def key(s: int):
+            n = min(len(self._pending[s]), self.grid.max_batch_for(s))
+            est = self._flush_cost_fn(s, n)
+            if est is None:
+                return (1, float(s), s)
+            return (0, float(est), s)
+
+        keys = {s: key(s) for s in eligible}
+        if all(k[0] == 1 for k in keys.values()):
+            return oldest
+        return min(eligible, key=keys.__getitem__)
+
     def _take_locked(self) -> Optional[tuple]:
         """Pop the next batch to launch, or None to keep waiting."""
         seq = self._full_seq()
         if seq is None:
-            pick = self._oldest_seq()
-            if pick is None:
-                return None
-            if not self._draining:
-                waited = time.monotonic() - self._pending[pick][0].enqueued_at
-                if waited < self.max_batch_delay_s:
-                    return None  # deadline not reached, nothing full
-            seq = pick
+            eligible = self._eligible_seqs()
+            if not eligible:
+                return None  # deadline not reached, nothing full
+            seq = self._rank_flush(eligible)
         q = self._pending[seq]
         take = min(len(q), self.grid.max_batch_for(seq))
         works = [q.popleft() for _ in range(take)]

@@ -18,7 +18,13 @@ request/response engine:
 - when a request's last chunk lands, chunks are reduced IN CHUNK ORDER with
   the predictor's exact validity rules (span order, answer not inside the
   question, best-score-wins with predictor tie semantics), and the winning
-  span is decoded back to text.
+  span is decoded back to text;
+- two optional byte-budgeted caches short-circuit the hot path
+  (``serve/cache.py``, off by default): document preprocessing by content
+  hash, and per-chunk result rows by exact-device-row hash + checkpoint
+  fingerprint + precision with single-flight dedup — cache-hit chunks
+  bypass the micro-batcher entirely, and responses are bit-identical
+  cached or not.
 
 HBM pre-flight (``preflight_predict_step``): at warmup each bucket's program
 is lowered + compiled once and XLA's ``memory_analysis()`` is read; a bucket
@@ -56,6 +62,15 @@ from ..parallel import build_mesh, make_global_array
 from ..utils.hbm import device_hbm_bytes, preflight_bytes
 from .batcher import ChunkWork, DrainingError, MicroBatcher, QueueFullError
 from .bucketing import Bucket, BucketGrid, pad_trailing_batch
+from .cache import (
+    ENTRY_OVERHEAD,
+    TOKEN_BYTES,
+    ByteBudgetLRU,
+    ChunkResultCache,
+    content_key,
+    params_fingerprint,
+    row_key,
+)
 from .metrics import Registry
 
 logger = logging.getLogger(__name__)
@@ -97,11 +112,17 @@ class QAResult:
 
 @dataclass
 class _ChunkRef:
-    """Batcher payload: which request, which chunk."""
+    """Batcher payload: which request, which chunk.
+
+    ``key`` is the chunk's tier-2 cache key when the chunk-result cache is
+    enabled and this chunk is the LEADER of a single-flight entry (the row
+    computed for it must be published via ``ChunkResultCache.complete`` /
+    ``fail_flight``); None otherwise."""
 
     ticket: "RequestTicket"
     idx: int
     input_ids: List[int]
+    key: Optional[str] = None
 
 
 class RequestTicket:
@@ -165,6 +186,8 @@ class QAEngine:
         doc_stride: int = 128,
         registry: Optional[Registry] = None,
         quantize: str = "off",
+        serve_cache_bytes: int = 0,
+        doc_cache_bytes: int = 0,
     ):
         self.model = model
         self.params = params
@@ -180,6 +203,30 @@ class QAEngine:
         # warmup report so an operator can tell at a glance what a replica
         # is running
         self.quantize = str(quantize or "off")
+
+        # -- serving hot-path caches (serve/cache.py; both off by default) ----
+        # tier 1: document preprocessing (encode_document tokens + the
+        # window_chunks layout), keyed by document content hash
+        self._doc_cache = (
+            ByteBudgetLRU(doc_cache_bytes) if doc_cache_bytes > 0 else None
+        )
+        # tier 2: per-chunk packed span-logit rows keyed by the exact device
+        # input row + checkpoint fingerprint + active precision, with
+        # single-flight dedup of identical in-flight chunks
+        self._chunk_cache = (
+            ChunkResultCache(serve_cache_bytes)
+            if serve_cache_bytes > 0 else None
+        )
+        # the fingerprint device->host copy is only paid when tier 2 can
+        # actually use it
+        self._fingerprint = (
+            params_fingerprint(params)
+            if self._chunk_cache is not None else None
+        )
+        # measured flush ranking (ROADMAP serving front (d)): per-(batch,
+        # seq) step-cost estimates are static once warmup records them, so
+        # the batcher-thread hook memoizes autotune-cache lookups here
+        self._flush_cost_memo: Dict[Tuple[int, int], Optional[float]] = {}
 
         # ids-only wire when the vocab fits uint16 (predictor parity — see
         # infer/score.py for the two wire formats)
@@ -262,6 +309,40 @@ class QAEngine:
 
         self.m_weight_bytes.set(param_bytes(params))
 
+        # cache series are registered unconditionally (budget 0 included):
+        # the /metrics surface must not change shape with configuration, and
+        # the docs-consistency gate walks every registered name
+        self._cache_metrics = {
+            name: {
+                "hits": m.counter(
+                    f"qa_{name}_cache_hits_total", f"{what} cache hits."),
+                "misses": m.counter(
+                    f"qa_{name}_cache_misses_total", f"{what} cache misses."),
+                "evictions": m.counter(
+                    f"qa_{name}_cache_evictions_total",
+                    f"{what} cache LRU evictions (byte budget)."),
+                "bytes": m.gauge(
+                    f"qa_{name}_cache_bytes",
+                    f"{what} cache resident bytes (exact accounting)."),
+                "entries": m.gauge(
+                    f"qa_{name}_cache_entries", f"{what} cache entries."),
+            }
+            for name, what in (
+                ("doc", "Tier-1 document-preprocessing"),
+                ("chunk", "Tier-2 chunk-result"),
+            )
+        }
+        self.m_flight_joins = m.counter(
+            "qa_chunk_flight_joins_total",
+            "Chunks that piggybacked on an identical in-flight chunk "
+            "(single-flight dedup wins).")
+        # mirror bookkeeping for _sync_cache_metrics: last-synced source
+        # values per series, under a lock — /metrics renders on concurrent
+        # HTTP handler threads, and an unguarded read-modify-write of the
+        # counter deltas would double-count under racing scrapes
+        self._cache_sync_lock = threading.Lock()
+        self._cache_synced: Dict[str, float] = {}
+
         self.batcher = MicroBatcher(
             grid,
             self._run_batch,
@@ -269,6 +350,12 @@ class QAEngine:
             queue_size=queue_size,
             fail_fn=self._fail_batch,
             on_depth=self.m_queue_depth.set,
+            # measured flush ranking needs a cost source: with the autotuner
+            # disabled every lookup would return None and the hook would
+            # reorder deadline flushes (ascending-seq fallback) with nothing
+            # measured behind it — keep the historical oldest-first order
+            flush_cost_fn=(
+                self._flush_cost if autotune.get().enabled else None),
         )
         self.warmup_report: Optional[dict] = None
 
@@ -351,6 +438,10 @@ class QAEngine:
             with self.mesh:
                 dev = self._wire_pack(self._dummy_inputs(bucket))
                 compiled = self._jit.lower(self.params, dev).compile()
+        # this compiled program is exactly what the flush-cost recorder
+        # needs — record here so warmup doesn't pay a second AOT compile
+        # (injected compile_fn fakes expose no cost_analysis: a no-op)
+        self._record_program_cost(bucket, compiled)
         try:
             analysis = compiled.memory_analysis()
         except Exception as e:  # noqa: BLE001 - analysis is best-effort
@@ -408,7 +499,24 @@ class QAEngine:
             # hot before traffic arrives
             with self.mesh:
                 dev = self._wire_pack(self._dummy_inputs(bucket))
+                # measured per-bucket admission (ROADMAP serving front (d)):
+                # persist XLA's cost_analysis() estimate of this bucket's
+                # whole program so deadline flushes rank by measured step
+                # cost. The HBM pre-flight above already recorded it from
+                # its own compile when it ran; this AOT compile happens only
+                # when no verdict exists yet — a warm restart finds the
+                # verdict cached (a no-estimate marker included) and skips
+                # it entirely (zero-probe startup intact: record_cost never
+                # touches the probe counters).
+                tuner = autotune.get()
+                est = tuner.lookup_cost(
+                    self._program_cost_key(bucket.batch, bucket.seq))
+                if tuner.enabled and est is None:
+                    est = self._record_program_cost(
+                        bucket, self._jit.lower(self.params, dev).compile())
                 np.asarray(self._jit(self.params, dev))
+            report.setdefault("program_costs", {})[str(bucket)] = (
+                est["est_seconds"] if est else None)
             report["buckets"].append(str(bucket))
         report["autotune"] = autotune.get().session_summary()
         report["warmup_seconds"] = round(time.perf_counter() - t0, 3)
@@ -422,7 +530,109 @@ class QAEngine:
         )
         return report
 
+    # -- measured flush ranking (batcher thread) -------------------------------
+
+    def _program_cost_key(self, batch: int, seq: int) -> str:
+        """Tuning-cache key of one bucket's whole serving program. Carries
+        the model geometry (the tuning cache is shared per device kind —
+        bert-tiny's step cost must never rank a bert-large grid), the wire
+        format, and the active precision (the ``q8`` suffix discipline of
+        ops/quant_matmul.py): each is a different compiled program with a
+        different measured cost."""
+        cfg = getattr(self.model, "cfg", None)
+        sig = (
+            f"h{cfg.hidden_size}l{cfg.num_layers}n{cfg.num_heads}"
+            f"v{cfg.vocab_size}" if cfg is not None else "anon"
+        )
+        wire = "ids" if self._wire_ids_only else "3p"
+        suffix = "-q8" if self.quantize == "int8" else ""
+        return f"serve-step-{batch}x{seq}-{sig}-{wire}{suffix}"
+
+    def _record_program_cost(self, bucket: Bucket, compiled) -> Optional[dict]:
+        """Persist ``compiled``'s ``cost_analysis()`` estimate for this
+        bucket's program in the autotune cache (flush ranking reads it
+        back), unless one is already cached. Returns the estimate in
+        effect, or None when the toolchain exposes none."""
+        tuner = autotune.get()
+        if not tuner.enabled:
+            return None
+        key = self._program_cost_key(bucket.batch, bucket.seq)
+        cached = tuner.lookup_cost(key)
+        if cached is not None and cached.get("est_seconds") is not None:
+            return cached
+        est = autotune.program_cost_estimate(compiled)
+        # persist even a no-estimate verdict ({"est_seconds": None}): the
+        # cost-probe compile must be paid once per cache lifetime, not once
+        # per startup on toolchains whose cost_analysis yields nothing —
+        # and a free compile (preflight already has one) may upgrade a
+        # stale no-estimate marker
+        tuner.record_cost(
+            key, est if est is not None else {"est_seconds": None})
+        return est
+
+    def _flush_cost(self, seq: int, n: int) -> Optional[float]:
+        """Estimated step cost of the program a deadline flush of ``n``
+        items at ``seq`` would launch, from the autotune cache's persisted
+        ``cost_analysis()`` verdicts (None -> the batcher falls back to
+        seq order). Memoized: the hook runs under the batcher lock and the
+        estimates are static after warmup."""
+        batch = self.grid.batch_for(seq, n)
+        memo_key = (batch, seq)
+        if memo_key not in self._flush_cost_memo:
+            est = autotune.get().lookup_cost(
+                self._program_cost_key(batch, seq))
+            self._flush_cost_memo[memo_key] = (
+                float(est["est_seconds"])
+                if est and est.get("est_seconds") is not None else None)
+        return self._flush_cost_memo[memo_key]
+
     # -- request admission -----------------------------------------------------
+
+    def _chunk_document(self, document: str, question_len: int) -> List:
+        """``encode_document`` + ``window_chunks`` for one request, through
+        the tier-1 cache when enabled.
+
+        Two entry kinds share the byte budget: the token stream keyed by
+        document content hash alone (question-independent — the same
+        document asked a hundred different questions of tokenizes once),
+        and the window layout keyed additionally by the question LENGTH +
+        grid geometry (the only question-dependence ``window_chunks`` has:
+        ``document_len = max_seq - question_len - 3``)."""
+        max_seq = self.grid.max_seq
+
+        def chunk(tokens):
+            # spanless target: serving has no gold answer; the chunker only
+            # needs geometry
+            return window_chunks(
+                tokens, ("unknown", -1, -1),
+                question_len=question_len, max_seq_len=max_seq,
+                doc_stride=self.doc_stride,
+            )
+
+        if self._doc_cache is None:
+            tokens, _, _ = encode_document(self.tokenizer, document)
+            return chunk(tokens)
+
+        doc_hash = content_key(document)
+        win_key = (f"win|{doc_hash}|q{question_len}|s{max_seq}"
+                   f"|d{self.doc_stride}")
+        records = self._doc_cache.get(win_key)
+        if records is not None:
+            return records
+        tok_key = f"tok|{doc_hash}"
+        tokens = self._doc_cache.get(tok_key)
+        if tokens is None:
+            tokens, _, _ = encode_document(self.tokenizer, document)
+            self._doc_cache.put(
+                tok_key, tokens,
+                ENTRY_OVERHEAD + len(tok_key) + len(tokens) * TOKEN_BYTES,
+            )
+        records = chunk(tokens)
+        cost = ENTRY_OVERHEAD + len(win_key) + sum(
+            (len(r.token_ids) + 4) * TOKEN_BYTES for r in records
+        )
+        self._doc_cache.put(win_key, records, cost)
+        return records
 
     def submit(self, question: str, document: str) -> RequestTicket:
         """Chunk + admit one request; returns a completion ticket.
@@ -437,6 +647,23 @@ class QAEngine:
             self.m_rejected_invalid.inc()
             raise RequestRejected("question and document must be non-empty")
 
+        # fast-fail under overload: when no request could possibly be
+        # admitted right now, reject BEFORE paying host-side tokenization
+        # and chunking (a saturated server must not burn CPU on requests it
+        # then 429s). submit_many below stays the authoritative
+        # all-or-nothing check. With the chunk-result cache enabled only
+        # the draining arm applies: a fully-hot request needs zero queue
+        # slots, so pre-rejecting on depth would 429 exactly the traffic
+        # the cache exists to serve.
+        try:
+            self.batcher.precheck(check_full=self._chunk_cache is None)
+        except QueueFullError:
+            self.m_rejected_full.inc()
+            raise
+        except DrainingError:
+            self.m_rejected_draining.inc()
+            raise
+
         max_seq = self.grid.max_seq
         enc_q = self.tokenizer.encode(question)[: self.max_question_len]
         if len(enc_q) + 3 >= max_seq:
@@ -445,19 +672,15 @@ class QAEngine:
                 f"question tokenizes to {len(enc_q)} tokens; the largest "
                 f"serving bucket ({max_seq}) leaves no room for a document"
             )
-        tokens, _, _ = encode_document(self.tokenizer, document)
-        # spanless target: serving has no gold answer; the chunker only
-        # needs geometry
-        records = window_chunks(
-            tokens, ("unknown", -1, -1),
-            question_len=len(enc_q), max_seq_len=max_seq,
-            doc_stride=self.doc_stride,
-        )
-        if len(records) > self.batcher.queue_size:
+        records = self._chunk_document(document, len(enc_q))
+        if self._chunk_cache is None and \
+                len(records) > self.batcher.queue_size:
             # more chunks than the queue can EVER hold: admission would
             # reject this request on an idle server too, so 429-and-retry
             # would loop forever — fail it as a client error up front,
-            # before paying per-chunk assembly
+            # before paying per-chunk assembly. With the chunk cache
+            # enabled only MISS chunks need queue slots, so the same bound
+            # applies to the leader count after classification instead
             self.m_rejected_invalid.inc()
             raise RequestRejected(
                 f"document chunks into {len(records)} windows, beyond the "
@@ -467,7 +690,7 @@ class QAEngine:
 
         ticket = RequestTicket(
             n_chunks=len(records), question_len=len(enc_q))
-        works: List[ChunkWork] = []
+        rows: List[Tuple[int, int, List[int]]] = []
         for idx, rec in enumerate(records):
             input_ids = assemble_input_ids(
                 self._cls_id, self._sep_id, enc_q, rec)
@@ -481,18 +704,98 @@ class QAEngine:
                     f"serving bucket (max {max_seq})"
                 )
             ticket.chunks.append(input_ids)
-            works.append(ChunkWork(
-                seq=seq, payload=_ChunkRef(ticket, idx, input_ids)))
+            rows.append((idx, seq, input_ids))
 
-        try:
-            self.batcher.submit_many(works)
-        except QueueFullError:
-            self.m_rejected_full.inc()
-            raise
-        except DrainingError:
-            self.m_rejected_draining.inc()
-            raise
+        cache = self._chunk_cache
+        if cache is None:
+            works = [
+                ChunkWork(seq=seq, payload=_ChunkRef(ticket, idx, input_ids))
+                for idx, seq, input_ids in rows
+            ]
+            try:
+                self.batcher.submit_many(works)
+            except QueueFullError:
+                self.m_rejected_full.inc()
+                raise
+            except DrainingError:
+                self.m_rejected_draining.inc()
+                raise
+            self.m_requests.inc()
+            return ticket
+
+        # tier-2 classify-and-admit, atomic under the cache lock: each chunk
+        # is a HIT (row served from the LRU, bypassing the batcher), a
+        # WAITER (identical row already in flight — piggyback, single-flight
+        # dedup), or a LEADER (leased flight; must reach the queue or be
+        # aborted under this same lock hold, so no thread can join a flight
+        # that never launches).
+        hits: List[Tuple[int, Dict[str, float]]] = []
+        works = []
+        leased: List[str] = []
+        # key hashing depends only on immutable inputs — do it OUTSIDE the
+        # cache lock so a many-window document doesn't serialize every other
+        # handler thread's admission and the batcher's result publication
+        keyed = [
+            (idx, seq, input_ids,
+             row_key(self._fingerprint, self.quantize, input_ids))
+            for idx, seq, input_ids in rows
+        ]
+        with cache.lock:
+            for idx, seq, input_ids, key in keyed:
+                row = cache.get(key)
+                if row is not None:
+                    hits.append((idx, row))
+                    continue
+                if cache.join_flight(key, (ticket, idx)):
+                    continue
+                leased.append(key)
+                works.append(ChunkWork(
+                    seq=seq,
+                    payload=_ChunkRef(ticket, idx, input_ids, key=key)))
+
+            def rollback():
+                # atomic rollback: drop our waiter registrations first
+                # (from other leaders' flights AND our own leased ones,
+                # so every undone join lands in flight_join_rollbacks),
+                # then forget the leased flights (no foreign waiter can
+                # have joined them — we still hold the lock)
+                cache.remove_waiters(ticket)
+                for key in leased:
+                    cache.abort_flight(key)
+
+            if len(works) > self.batcher.queue_size:
+                # only MISS chunks need queue slots; more of them than the
+                # queue can EVER hold is a permanent client error (the
+                # no-cache path rejects this shape before assembly)
+                rollback()
+                self.m_rejected_invalid.inc()
+                raise RequestRejected(
+                    f"document needs {len(works)} uncached windows, beyond "
+                    f"the work queue's total capacity "
+                    f"({self.batcher.queue_size}); split the document or "
+                    f"raise queue_size"
+                )
+            if works:
+                try:
+                    self.batcher.submit_many(works)
+                except (QueueFullError, DrainingError) as exc:
+                    rollback()
+                    if isinstance(exc, QueueFullError):
+                        self.m_rejected_full.inc()
+                    else:
+                        self.m_rejected_draining.inc()
+                    raise
         self.m_requests.inc()
+        # hit rows flow to the ticket only after admission succeeded (a
+        # rejected request must leave no partial state); a fully-hot request
+        # finalizes right here on the handler thread — it never touches the
+        # batcher, the queue, or the device
+        done = False
+        for idx, row in hits:
+            if ticket._offer(idx, row):
+                done = True
+        if done:
+            self._finalize(ticket)
         return ticket
 
     # -- batch execution (batcher thread) --------------------------------------
@@ -527,19 +830,41 @@ class QAEngine:
             1.0 - float(lengths.sum()) / float(batch * seq))
 
         decoded = {k: out[i] for i, k in enumerate(OUT_KEYS)}
+        cache = self._chunk_cache
         for i, w in enumerate(works):
             ref: _ChunkRef = w.payload
             row = {k: float(decoded[k][i]) for k in OUT_KEYS}
-            if ref.ticket._offer(ref.idx, row):
-                self._finalize(ref.ticket)
+            offers = [(ref.ticket, ref.idx)]
+            if cache is not None and ref.key is not None:
+                # publish the leader's row: cache it for future requests and
+                # release every single-flight waiter with the SAME object —
+                # cached and computed responses are bit-identical by
+                # construction
+                waiters, _ = cache.complete(
+                    ref.key, row,
+                    ENTRY_OVERHEAD + len(ref.key) + 8 * len(OUT_KEYS),
+                )
+                offers.extend(waiters)
+            for ticket, idx in offers:
+                if ticket._offer(idx, row):
+                    self._finalize(ticket)
 
     def _fail_batch(self, works: Sequence[ChunkWork], exc: BaseException) -> None:
+        cache = self._chunk_cache
         failed = set()
-        for w in works:
-            ticket = w.payload.ticket
+
+        def fail(ticket: RequestTicket) -> None:
             if id(ticket) not in failed:
                 failed.add(id(ticket))
                 ticket._fail(exc)
+
+        for w in works:
+            fail(w.payload.ticket)
+            if cache is not None and w.payload.key is not None:
+                # single-flight waiters were promised this leader's row;
+                # nothing is cached and their tickets fail with it
+                for ticket, _ in cache.fail_flight(w.payload.key):
+                    fail(ticket)
         self.m_failed.inc(len(failed))
 
     # -- reduction (predictor.py:63-87 parity) ---------------------------------
@@ -594,6 +919,45 @@ class QAEngine:
 
     # -- metrics / shutdown ----------------------------------------------------
 
+    def cache_stats(self) -> dict:
+        """Both tiers' live stats (None for a disabled tier) — the bench
+        JSON line and /metrics mirroring read this one surface."""
+        out = {"doc": None, "chunk": None}
+        if self._doc_cache is not None:
+            out["doc"] = self._doc_cache.stats()
+        if self._chunk_cache is not None:
+            out["chunk"] = self._chunk_cache.stats()
+            out["chunk"]["flight_joins"] = self._chunk_cache.flight_joins
+            out["chunk"]["flight_join_rollbacks"] = (
+                self._chunk_cache.flight_join_rollbacks)
+            out["chunk"]["inflight"] = self._chunk_cache.inflight()
+        return out
+
+    def _sync_cache_metrics(self) -> None:
+        """Mirror the caches' own monotonic stats into the Prometheus
+        series. The whole read-delta-inc runs under one lock with a
+        last-synced snapshot (NOT a read-back of the counter): /metrics
+        renders on concurrent HTTP handler threads, and two racing scrapes
+        computing the same delta would otherwise double-count. Rollback
+        corners may briefly move a source stat backwards, hence the max."""
+        stats = self.cache_stats()
+        with self._cache_sync_lock:
+            for name, s in stats.items():
+                if s is None:
+                    continue
+                mm = self._cache_metrics[name]
+                for k in ("hits", "misses", "evictions"):
+                    last = self._cache_synced.setdefault(f"{name}.{k}", 0.0)
+                    mm[k].inc(max(0.0, s[k] - last))
+                    self._cache_synced[f"{name}.{k}"] = max(last, float(s[k]))
+                mm["bytes"].set(s["bytes"])
+                mm["entries"].set(s["entries"])
+            if stats["chunk"] is not None:
+                last = self._cache_synced.setdefault("flight_joins", 0.0)
+                joins = float(stats["chunk"]["flight_joins"])
+                self.m_flight_joins.inc(max(0.0, joins - last))
+                self._cache_synced["flight_joins"] = max(last, joins)
+
     def render_metrics(self) -> str:
         for gauge, q in ((self.m_latency_p50, 0.5),
                          (self.m_latency_p95, 0.95),
@@ -601,6 +965,7 @@ class QAEngine:
             v = self.m_latency.quantile(q)
             if v is not None:
                 gauge.set(v)
+        self._sync_cache_metrics()
         return self.metrics.render()
 
     def drain(self, timeout: Optional[float] = None) -> bool:

@@ -246,6 +246,14 @@ class Registry:
         with self._lock:
             return self._metrics.get(name)
 
+    def names(self) -> List[str]:
+        """Every registered metric name (the docs-consistency gate in
+        tests/test_serve_cache.py walks this against /metrics output and
+        the README metrics table, so the Prometheus surface cannot
+        silently drift from the docs)."""
+        with self._lock:
+            return list(self._metrics)
+
     def render(self) -> str:
         """Prometheus text exposition format (version 0.0.4)."""
         with self._lock:

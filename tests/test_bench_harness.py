@@ -275,6 +275,48 @@ def test_bench_serve_emits_closed_loop_latency_json(bench, capsys):
     assert parsed["quant_mem_bytes"] is None
     assert parsed["parity_span_agreement"] is None
     assert parsed["parity_score_max_delta"] is None
+    # caches off by default: no hot-set fields beyond the null provenance
+    assert parsed["hot_fraction"] == 0.0
+    assert parsed["chunk_cache"] is None and parsed["doc_cache"] is None
+    assert parsed["chunk_cache_hit_rate"] is None
+
+
+def test_bench_serve_hot_set_workload_pins_cache_win(bench, capsys):
+    """ISSUE-7 acceptance: ``--mode serve`` with the hot-set workload
+    (>=50% repeated question/document pairs) reports cache hit rate in the
+    JSON and shows >=5x lower p50 latency for hit-served requests vs
+    miss-served on CPU. The priming pass makes every hot pick a true
+    repeat, so the split measures steady-state cache behavior."""
+    import types
+
+    args = types.SimpleNamespace(
+        model="bert-tiny",
+        serve_buckets="4x64",
+        serve_clients=2,
+        serve_requests=16,
+        serve_queue_size=32,
+        serve_hot_fraction=0.6,
+        serve_hot_docs=2,
+        serve_cache_bytes=1 << 20,
+        doc_cache_bytes=1 << 20,
+        max_batch_delay_ms=5.0,
+        doc_stride=32,
+        ln_impl="xla",
+        hbm_preflight=False,
+    )
+    bench.bench_serve(args)
+    out = capsys.readouterr().out.strip().splitlines()
+    parsed = json.loads(out[-1])
+    assert parsed["requests"] == 16 and parsed["failed"] == 0
+    assert parsed["hot_fraction"] == 0.6
+    assert parsed["hot_requests"] >= 1
+    assert parsed["chunk_cache"]["hits"] >= parsed["hot_requests"]
+    assert 0 < parsed["chunk_cache_hit_rate"] <= 1
+    assert 0 < parsed["doc_cache_hit_rate"] <= 1
+    # the headline cache win: hit-served p50 at least 5x below miss-served
+    assert parsed["p50_hit_ms"] is not None
+    assert parsed["p50_miss_ms"] is not None
+    assert parsed["p50_hit_ms"] * 5 <= parsed["p50_miss_ms"], parsed
 
 
 def test_bench_input_packed_pass_pins_waste_reduction(bench, capsys):
