@@ -935,6 +935,80 @@ def bench_converge(args) -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _opt_bytes(trainer):
+    """Measured per-chip optimizer-state bytes of a live trainer (one
+    shard per leaf under zero1), or None before init."""
+    from ml_recipe_tpu.parallel.sharding import opt_state_bytes_per_chip
+
+    state, _ = trainer._split_ls()
+    return opt_state_bytes_per_chip(state) if state is not None else None
+
+
+def param_count_probe(args) -> None:
+    """``--mode train --param_count_probe``: modeled replicated-vs-zero1
+    optimizer bytes per chip WITHOUT running (or even compiling) a step —
+    param and state shapes come from ``jax.eval_shape``, the ZeRO-1 layout
+    from the same padding-aware per-leaf plan the trainer applies
+    (parallel/sharding.zero1_state_bytes), so HBM planning for a pod shape
+    works before a TPU window opens. ``--probe_devices N`` models any
+    data-axis width; the default is the visible device count."""
+    import jax
+    import jax.numpy as jnp
+
+    from ml_recipe_tpu.models import MODEL_PRESETS, QAModel
+    from ml_recipe_tpu.parallel.sharding import zero1_state_bytes
+    from ml_recipe_tpu.train.optim import build_optimizer
+
+    cfg = MODEL_PRESETS[args.model]
+    cfg = _widen_positions(cfg, args.seq_len)
+    model = QAModel(cfg, dtype=jnp.bfloat16)
+    param_shapes = jax.eval_shape(
+        lambda key: model.init(key, jnp.zeros((1, 8), jnp.int32)),
+        jax.random.key(0),
+    )["params"]
+
+    class TP:
+        lr = 1e-5; weight_decay = 1e-4; warmup_coef = 0.0
+        optimizer = args.optimizer; finetune = False
+
+    tx, _, _ = build_optimizer(
+        TP(), param_shapes, num_training_steps=1000, max_grad_norm=None,
+        warmup_coef=0.0,
+    )
+    state_shapes = jax.eval_shape(tx.init, param_shapes)
+    n = args.probe_devices or len(jax.devices())
+    zero1 = zero1_state_bytes(
+        state_shapes, data_size=n, min_size=args.zero_min_size
+    )
+    param_count = sum(
+        int(np.prod(l.shape or (1,), dtype=np.int64))
+        for l in jax.tree_util.tree_leaves(param_shapes)
+    )
+    print(
+        json.dumps(
+            {
+                "mode": "param_count_probe",
+                "model": args.model,
+                "optimizer": args.optimizer,
+                "param_count": param_count,
+                "devices": int(n),
+                "zero_min_size": int(args.zero_min_size),
+                "opt_bytes_per_chip_replicated": zero1["replicated_bytes"],
+                "opt_bytes_per_chip_zero1": zero1["zero1_bytes"],
+                # the replicated footprint of exactly the leaves zero1
+                # shards — the (N-1)/N savings base
+                "opt_bytes_sharded_leaves": zero1["sharded_bytes"],
+                "zero1_savings_pct": round(
+                    100.0
+                    * (1.0 - zero1["zero1_bytes"]
+                       / max(zero1["replicated_bytes"], 1)),
+                    2,
+                ),
+            }
+        )
+    )
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--mode",
@@ -1052,6 +1126,34 @@ def main() -> None:
     parser.add_argument("--hbm_preflight", type=_str2bool, default=True,
                         help="Raise batch_split from compiled "
                              "memory_analysis instead of OOMing in XLA.")
+    # ZeRO-1 sharded optimizer state (train mode + the HBM-planning probe)
+    parser.add_argument("--optimizer_sharding", type=str, default="off",
+                        choices=["off", "zero1"],
+                        help="train mode: optimizer-state layout — 'zero1' "
+                             "shards every state leaf over the mesh data "
+                             "axis (memory ~1/N per chip; grads reduce-"
+                             "scatter, updated params all-gather). The "
+                             "JSON line gains opt_sharding / "
+                             "opt_state_bytes_per_chip either way.")
+    parser.add_argument("--optimizer", type=str, default="adam",
+                        choices=["adam", "adamod"],
+                        help="train mode + --param_count_probe: optimizer "
+                             "whose state is sized (adam: 2 f32 moments, "
+                             "adamod: 3).")
+    parser.add_argument("--param_count_probe", action="store_true",
+                        help="train mode: print modeled replicated-vs-"
+                             "zero1 optimizer bytes per chip from "
+                             "eval_shape alone — no step is compiled or "
+                             "run, so pod-scale HBM planning works before "
+                             "a TPU window opens.")
+    parser.add_argument("--probe_devices", type=int, default=None,
+                        help="--param_count_probe: model this data-axis "
+                             "width instead of the visible device count "
+                             "(e.g. 64 for a planned v5e-64 run).")
+    parser.add_argument("--zero_min_size", type=int, default=16384,
+                        help="zero1: state leaves below this many elements "
+                             "stay replicated (sharding them buys nothing "
+                             "and costs collective latency).")
     parser.add_argument("--quantize", type=str, default="off",
                         choices=["off", "int8"],
                         help="infer/serve modes: post-training int8 "
@@ -1082,6 +1184,10 @@ def main() -> None:
     if args.mode == "serve":
         return bench_serve(args)
 
+    if args.param_count_probe:
+        # modeled bytes only — no params materialized, no step compiled
+        return param_count_probe(args)
+
     import jax
     import jax.numpy as jnp
 
@@ -1102,7 +1208,7 @@ def main() -> None:
         loss = "smooth"; smooth_alpha = 0.01; focal_alpha = 1; focal_gamma = 2
         w_start = 1; w_end = 1; w_start_reg = 1; w_end_reg = 1; w_cls = 1
         lr = 1e-5; weight_decay = 1e-4; warmup_coef = 0.0
-        optimizer = "adam"; finetune = False
+        optimizer = args.optimizer; finetune = False
 
     rng = np.random.default_rng(0)
     B, L = args.global_batch, args.seq_len
@@ -1115,6 +1221,8 @@ def main() -> None:
         collate_fun=None, trainer_params=None,  # step built manually below
         mesh=mesh, batch_split=args.batch_split, seed=0,
         train_batch_size=args.global_batch, hbm_preflight=args.hbm_preflight,
+        optimizer_sharding=args.optimizer_sharding,
+        zero_min_size=args.zero_min_size,
     )
     # test-only Trainer skips optimizer construction; build it for the bench
     from ml_recipe_tpu.train.optim import build_optimizer
@@ -1222,6 +1330,10 @@ def main() -> None:
                 # pre-flight may have raised this above --batch_split
                 "batch_split": trainer.batch_split,
                 "hbm_preflight": trainer.preflight_report,
+                # optimizer-state layout + measured per-chip residency
+                # (zero1: ~1/N of the replicated footprint)
+                "opt_sharding": trainer.effective_opt_sharding,
+                "opt_state_bytes_per_chip": _opt_bytes(trainer),
                 # tuning provenance: 'hit' = every geometry served from the
                 # on-disk cache (zero compile probes this run)
                 "autotune_cache": tuning["cache"],

@@ -153,6 +153,7 @@ def _run_worker(params, model_params, watchdog) -> None:
         drop_optimizer=params.drop_optimizer,
         debug=params.debug,
         seed=params.seed if params.seed is not None else 0,
+        optimizer_sharding=getattr(params, "optimizer_sharding", None),
         shard_optimizer=getattr(params, "shard_optimizer", False),
         sharded_checkpoint=getattr(params, "sharded_checkpoint", False),
         trace_dir=(

@@ -395,11 +395,25 @@ def get_trainer_parser() -> ConfigArgumentParser:
 
     parser.add_argument("--max_grad_norm", type=float, default=1,
                         help="Max global norm of the gradients")
+    parser.add_argument("--optimizer_sharding", type=cast2(str), default=None,
+                        choices=[None, "off", "zero1"],
+                        help="Optimizer-state layout: 'zero1' shards every "
+                             "AdamW/AdaMod state leaf over the mesh data "
+                             "axis (padding-aware per-leaf specs; memory "
+                             "~1/N per chip) and runs the weight update on "
+                             "each replica's shard only — grads reduce-"
+                             "scatter, updated params all-gather back "
+                             "replicated. 'off' replicates the full state "
+                             "per chip (historical layout; 1-chip zero1 is "
+                             "bit-identical to off). Default defers to the "
+                             "legacy --shard_optimizer boolean.")
     parser.add_argument("--shard_optimizer", action="store_true",
-                        help="ZeRO-1: shard optimizer moments over the mesh "
-                             "data axis (memory 1/N; XLA all-gathers the "
-                             "sharded updates). The reference replicates "
-                             "optimizer state per process.")
+                        help="Legacy alias of --optimizer_sharding zero1 "
+                             "(kept for existing configs): shard optimizer "
+                             "moments over the mesh data axis (memory 1/N; "
+                             "XLA all-gathers the sharded updates). The "
+                             "reference replicates optimizer state per "
+                             "process.")
     parser.add_argument("--sharded_checkpoint", action="store_true",
                         help="Checkpoint saves write a per-process sharded "
                              "directory (each host saves only the array "

@@ -24,10 +24,11 @@ answer upsampling and epoch determinism survive; only batch *composition*
 changes (each batch is drawn from one bucket's arrival queue).
 
 Multi-host note: bucket composition depends on item CONTENT (lengths), which
-each host would have to know for the full global ordering to keep step shapes
-in lockstep; that coordination is future work, so the bucketed loader is
-single-process (the Trainer falls back to pad-to-max batching on multi-host
-meshes, with a warning).
+every host must agree on for step shapes to stay in lockstep. Multi-host
+loaders derive the identical per-epoch bucket plan from the SHARED LENGTH
+ORACLE (``data/packing.oracle_read`` — item reads become a pure function of
+``(epoch, index)``), then each host collates only its contiguous row slice
+of every planned global batch; see :meth:`BucketedDataLoader._iter_oracle`.
 """
 
 from __future__ import annotations
@@ -184,6 +185,14 @@ class BucketedDataLoader:
     (drop_last parity: no padding rows ever reach the loss); eval mode
     (``pad_last=True``) pads tails by repeating the last real item and
     reports ``real_rows`` so consumers trim before metric averaging.
+
+    Multi-host (``sampler.process_count > 1``): every host derives the SAME
+    epoch bucket plan from the shared length oracle
+    (data/packing.oracle_read — item lengths become a pure function of the
+    index) and collates only its contiguous row slice of each planned
+    global bucket batch, so step shapes stay in lockstep across hosts with
+    zero coordination traffic. ``rows``/``real_rows`` on the emitted
+    batches stay GLOBAL counts.
     """
 
     def __init__(
@@ -200,12 +209,8 @@ class BucketedDataLoader:
         read_retries: int = 3,
         pad_last: bool = False,
     ):
-        if getattr(sampler, "process_count", 1) != 1:
-            raise ValueError(
-                "BucketedDataLoader is single-process: bucket composition is "
-                "length-dependent and multi-host step shapes would diverge "
-                "(use the pad-to-max DataLoader on multi-host meshes)."
-            )
+        self.process_index = int(getattr(sampler, "process_index", 0))
+        self.process_count = int(getattr(sampler, "process_count", 1))
         self.dataset = dataset
         self.sampler = sampler
         self.collate_fun = collate_fun
@@ -234,6 +239,12 @@ class BucketedDataLoader:
         multiple — the HBM pre-flight calls this after raising
         ``batch_split`` (must happen before iteration starts)."""
         self.batch_multiple = max(1, int(batch_multiple))
+        if self.process_count > 1 and self.batch_multiple % self.process_count:
+            raise ValueError(
+                f"batch_multiple {self.batch_multiple} must divide over "
+                f"{self.process_count} hosts (each host collates its "
+                f"contiguous row slice of every planned global bucket batch)"
+            )
         self.batch_sizes = bucket_batch_sizes(
             self.seq_grid, self.token_budget, multiple=self.batch_multiple
         )
@@ -276,7 +287,7 @@ class BucketedDataLoader:
         return plan_scaled_count(
             self.dataset, self.sampler, epoch, cache=self._len_cache,
             n_jobs=self.n_jobs, read_retries=self.read_retries,
-            simulate=simulate,
+            simulate=simulate, oracle=self.process_count > 1,
         ) + tail[0]
 
     def _collate_for(self, seq: int):
@@ -300,7 +311,96 @@ class BucketedDataLoader:
             inputs=inputs, labels=labels, seq=seq, real_rows=real, rows=rows
         )
 
+    def _iter_oracle(self):
+        """Multi-host epoch: plan globally from oracle lengths, collate the
+        local row slice (the bucketed twin of
+        ``PackedDataLoader._iter_oracle``). The plan — which items form
+        which (seq, rows) batch, in which order — is a pure function of the
+        deterministic epoch ordering and the oracle lengths, so every host
+        computes it identically and per-step shapes stay in lockstep."""
+        from .packing import (
+            _oracle_epoch_key,
+            oracle_epoch_lengths,
+            oracle_read,
+        )
+
+        indices = [int(i) for i in self.sampler.epoch_indices(self._epoch)]
+        self._last_stats = stats = {
+            "real_tokens": 0,
+            "bucket_tokens": 0,
+            "padmax_tokens": 0,
+            "batches": 0,
+            "items": 0,
+            "dropped_items": 0,
+        }
+        lengths = oracle_epoch_lengths(
+            self.dataset, indices, cache=self._len_cache,
+            n_jobs=self.n_jobs, read_retries=self.read_retries,
+            epoch=self._epoch,
+        )
+        ek = _oracle_epoch_key(self.dataset, self._epoch)
+        bucketer = TokenBudgetBucketer(self.seq_grid, self.batch_sizes)
+        plan = []  # (seq, [(index, length)], real)
+        for idx, length in zip(indices, lengths):
+            emitted = bucketer.add(length, (idx, length))
+            if emitted is not None:
+                plan.append((emitted[0], emitted[1], len(emitted[1])))
+        for seq, tail_items in bucketer.flush():
+            if self.pad_last:
+                real = len(tail_items)
+                pad = self.batch_sizes[seq] - real
+                plan.append((seq, tail_items + [tail_items[-1]] * pad, real))
+            else:
+                stats["dropped_items"] += len(tail_items)
+
+        def submit(pool, entries):
+            rows = len(entries)
+            local_rows = rows // self.process_count
+            lo = self.process_index * local_rows
+            return [
+                pool.submit(
+                    oracle_read, self.dataset, idx,
+                    retries=self.read_retries, epoch=ek,
+                )
+                for idx, _ in entries[lo:lo + local_rows]
+            ]
+
+        # ONE pool for the epoch, reads submitted a batch ahead (mirrors
+        # the single-process path's sliding read window): the next batch's
+        # reads overlap this batch's collate and the device step
+        with ThreadPoolExecutor(max_workers=self.n_jobs) as pool:
+            pending: deque = deque()
+            for i in range(min(2, len(plan))):
+                pending.append(submit(pool, plan[i][1]))
+            for i, (seq, entries, real) in enumerate(plan):
+                futures = pending.popleft()
+                if i + 2 < len(plan):
+                    pending.append(submit(pool, plan[i + 2][1]))
+                items = [f.result() for f in futures]
+                out = self._collate_for(seq)(items)
+                rows = len(entries)
+                stats["real_tokens"] += sum(
+                    length for _, length in entries[:real]
+                )
+                stats["bucket_tokens"] += rows * seq
+                stats["padmax_tokens"] += real * self.seq_grid[-1]
+                stats["batches"] += 1
+                stats["items"] += real
+                yield BucketedBatch(
+                    inputs=out[0], labels=out[1], seq=seq, real_rows=real,
+                    rows=rows,
+                )
+        if stats["dropped_items"]:
+            logger.info(
+                "Bucketed epoch dropped %d partial-bucket tail items "
+                "(drop_last parity; they re-enter next epoch's shuffle).",
+                stats["dropped_items"],
+            )
+
     def __iter__(self):
+        if self.process_count > 1:
+            yield from self._iter_oracle()
+            return
         indices = [int(i) for i in self.sampler.epoch_indices(self._epoch)]
         self._last_stats = stats = {
             "real_tokens": 0,

@@ -32,15 +32,22 @@ kernels' mask operand and every regime (fused / q-blocked / streaming,
 forward AND backward) applies the block-diagonal permission grid
 ``q_seg == k_seg != 0`` (ops/flash_attention.py, ops/flash_streaming.py).
 
-Multi-host note: like bucketing, packing is content-dependent (row
-composition depends on chunk lengths), so the loader is single-process;
-the Trainer falls back to the pad-to-max path on multi-host meshes with a
-warning.
+Multi-host note: packing (like bucketing) is content-dependent — row
+composition depends on chunk lengths, which every host must agree on for
+step shapes to stay in lockstep. The multi-host path solves this with the
+SHARED LENGTH ORACLE (:func:`oracle_read` / :func:`oracle_epoch_lengths`):
+item reads pin the dataset's chunk-sampling RNG to a pure function of
+``(ORACLE_SEED, index)``, so every host materializes bit-identical items
+and derives the SAME per-epoch pack/bucket plan from the deterministic
+epoch ordering — each host then collates only its contiguous row slice of
+every planned global batch. No coordination traffic; the plan is pure
+function of (seed, lengths).
 """
 
 from __future__ import annotations
 
 import logging
+import threading
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, NamedTuple, Optional, Sequence
@@ -50,6 +57,81 @@ import numpy as np
 from .loader import _read_with_retry
 
 logger = logging.getLogger(__name__)
+
+# Seed of the shared length oracle: item reads under the oracle pin the
+# dataset's chunk-sampling RNG to default_rng([ORACLE_SEED, index]), making
+# every read a pure function of the index — the property that lets N hosts
+# agree on every item length (and therefore on the whole epoch's bucket/
+# pack plan) without exchanging a byte.
+ORACLE_SEED = 0x0AC1E
+
+# dataset.rng swap is process-global state; serialize oracle reads of
+# rng-carrying datasets (rng-less datasets — the common deterministic
+# corpora — read fully parallel)
+_ORACLE_LOCK = threading.Lock()
+
+
+def oracle_read(dataset, index: int, *, retries: int = 3, epoch: int = 0):
+    """One deterministic item read: when the dataset carries a chunk-
+    sampling ``rng``, it is swapped for a throwaway seeded by
+    ``(ORACLE_SEED, epoch, index)`` for the duration — the read becomes a
+    pure function of ``(epoch, index)``, identical on every host and on
+    every repeat (the length pass and the later collate pass see the SAME
+    item), while still drawing FRESH chunks each epoch exactly like the
+    single-host live-rng path does. The training draw stream is
+    untouched."""
+    if getattr(dataset, "rng", None) is None:
+        return _read_with_retry(dataset, int(index), retries=retries)
+    with _ORACLE_LOCK:
+        saved = dataset.rng
+        dataset.rng = np.random.default_rng(
+            np.random.SeedSequence([ORACLE_SEED, int(epoch), int(index)])
+        )
+        try:
+            return _read_with_retry(dataset, int(index), retries=retries)
+        finally:
+            dataset.rng = saved
+
+
+def _oracle_epoch_key(dataset, epoch: int) -> int:
+    """Cache-key epoch component: deterministic (rng-less) datasets return
+    the same item every epoch, so their lengths are cached ONCE across the
+    whole run; stochastic-chunk datasets draw per-epoch under the oracle
+    and their lengths are cached per epoch."""
+    return int(epoch) if getattr(dataset, "rng", None) is not None else 0
+
+
+def oracle_epoch_lengths(dataset, indices, *, cache: Dict[tuple, int],
+                         n_jobs: int, read_retries: int,
+                         epoch: int = 0) -> List[int]:
+    """Item lengths for ``indices`` under the shared oracle, reading each
+    UNIQUE ``(epoch, index)`` at most once (``cache`` persists across
+    epochs and is EXACT here — oracle reads are reproducible, unlike the
+    planning-only estimates of :func:`epoch_item_lengths`).
+
+    Cost model: deterministic (rng-less) corpora read fully parallel and
+    their lengths are cached ONCE for the whole run; stochastic-chunk
+    (rng-carrying) datasets re-draw per epoch AND serialize on the oracle
+    lock (``dataset.rng`` is shared mutable state — there is no parallel
+    read under a pinned generator), so every host pays one serial
+    materialization pass over the epoch per epoch. That is the price of
+    host-agreed plans with live chunk re-sampling; corpora where it bites
+    should pre-tokenize (drop the rng) or accept frozen epoch-0 draws."""
+    ek = _oracle_epoch_key(dataset, epoch)
+    missing = sorted({int(i) for i in indices if (ek, int(i)) not in cache})
+    if missing:
+        with ThreadPoolExecutor(max_workers=max(1, n_jobs)) as pool:
+            for idx, item in zip(
+                missing,
+                pool.map(
+                    lambda i: oracle_read(
+                        dataset, i, retries=read_retries, epoch=ek
+                    ),
+                    missing,
+                ),
+            ):
+                cache[(ek, idx)] = len(item.input_ids)
+    return [cache[(ek, int(i))] for i in indices]
 
 # Per-row segment cap: keeps the per-segment label planes ([rows, S]) and
 # the model's per-segment head outputs at one static shape. 8 comfortably
@@ -89,17 +171,26 @@ PLAN_SAMPLE_ITEMS = 4096
 
 def epoch_item_lengths(dataset, sampler, epoch, *, cache: Dict[int, int],
                        n_jobs: int, read_retries: int,
-                       max_items: Optional[int] = None) -> List[int]:
+                       max_items: Optional[int] = None,
+                       oracle: bool = False) -> List[int]:
     """Item lengths in one epoch's order (truncated to ``max_items`` when
     given), reading each UNIQUE index at most once (``cache`` persists
     across epochs — for stochastic-chunk datasets the cached length is one
     draw, an estimate by construction). The dataset's chunk-sampling RNG,
     when it has one, is swapped for a throwaway during the reads so
     PLANNING never perturbs the training draw stream. Shared by the packed
-    and bucketed loaders' LR-schedule step planning."""
+    and bucketed loaders' LR-schedule step planning. ``oracle=True``
+    switches the reads to the shared length oracle (per-index pinned RNG):
+    exact and host-invariant — what multi-host planning must use, since a
+    host-divergent step estimate would diverge the LR schedule itself."""
     indices = [int(i) for i in sampler.epoch_indices(epoch)]
     if max_items is not None:
         indices = indices[:max_items]
+    if oracle:
+        return oracle_epoch_lengths(
+            dataset, indices, cache=cache, n_jobs=n_jobs,
+            read_retries=read_retries, epoch=epoch,
+        )
     missing = sorted({i for i in indices if i not in cache})
     if missing:
         saved_rng = getattr(dataset, "rng", None)
@@ -124,7 +215,8 @@ def epoch_item_lengths(dataset, sampler, epoch, *, cache: Dict[int, int],
 
 
 def plan_scaled_count(dataset, sampler, epoch, *, cache: Dict[int, int],
-                      n_jobs: int, read_retries: int, simulate) -> int:
+                      n_jobs: int, read_retries: int, simulate,
+                      oracle: bool = False) -> int:
     """Shared LR-schedule planning skeleton of the packed and bucketed
     loaders: read the epoch's item lengths (prefix-bounded by
     ``PLAN_SAMPLE_ITEMS``), run the loader-specific ``simulate(lengths) ->
@@ -135,6 +227,7 @@ def plan_scaled_count(dataset, sampler, epoch, *, cache: Dict[int, int],
     lengths = epoch_item_lengths(
         dataset, sampler, epoch, cache=cache, n_jobs=n_jobs,
         read_retries=read_retries, max_items=PLAN_SAMPLE_ITEMS,
+        oracle=oracle,
     )
     count = simulate(lengths)
     if lengths and n_total > len(lengths):
@@ -313,6 +406,14 @@ class PackedDataLoader:
     drops the partial final BATCH of rows at epoch end (drop_last parity);
     eval mode (``pad_last=True``) pads it by repeating the last real row
     with ``segment_mask`` zeroed, so consumers need no trimming.
+
+    Multi-host (``sampler.process_count > 1``): every host derives the SAME
+    epoch pack plan from the shared length oracle (item lengths are a pure
+    function of the index under :func:`oracle_read`) and collates only its
+    contiguous ``rows_per_batch / process_count`` row slice of each planned
+    global batch — step shapes stay in lockstep with zero coordination
+    traffic. ``rows``/``segments`` on the emitted batches stay GLOBAL
+    counts (what metric weighting and partial-batch trimming key on).
     """
 
     def __init__(
@@ -330,11 +431,13 @@ class PackedDataLoader:
         read_retries: int = 3,
         pad_last: bool = False,
     ):
-        if getattr(sampler, "process_count", 1) != 1:
+        self.process_index = int(getattr(sampler, "process_index", 0))
+        self.process_count = int(getattr(sampler, "process_count", 1))
+        if self.process_count > 1 and rows_per_batch % self.process_count:
             raise ValueError(
-                "PackedDataLoader is single-process: row composition is "
-                "length-dependent and multi-host step shapes would diverge "
-                "(use the pad-to-max DataLoader on multi-host meshes)."
+                f"rows_per_batch {rows_per_batch} must divide over "
+                f"{self.process_count} hosts (each host collates its "
+                f"contiguous row slice of every planned global batch)"
             )
         self.dataset = dataset
         self.sampler = sampler
@@ -386,7 +489,7 @@ class PackedDataLoader:
         rows = plan_scaled_count(
             self.dataset, self.sampler, epoch, cache=self._len_cache,
             n_jobs=self.n_jobs, read_retries=self.read_retries,
-            simulate=simulate,
+            simulate=simulate, oracle=self.process_count > 1,
         )
         if self.pad_last:
             return -(-rows // self.rows_per_batch)
@@ -416,7 +519,118 @@ class PackedDataLoader:
             seq=self.max_seq_len,
         )
 
+    def _iter_oracle(self):
+        """Multi-host epoch: plan globally from oracle lengths, collate the
+        local row slice. Every host computes the identical plan (pure
+        function of the deterministic epoch ordering + oracle lengths), so
+        per-step shapes, segment counts and stats agree bit-for-bit across
+        hosts while each host only materializes 1/process_count of the
+        rows for the device."""
+        indices = [int(i) for i in self.sampler.epoch_indices(self._epoch)]
+        self._last_stats = stats = {
+            "real_tokens": 0,
+            "physical_tokens": 0,
+            "padmax_tokens": 0,
+            "rows": 0,
+            "batches": 0,
+            "items": 0,
+            "dropped_items": 0,
+        }
+        lengths = oracle_epoch_lengths(
+            self.dataset, indices, cache=self._len_cache,
+            n_jobs=self.n_jobs, read_retries=self.read_retries,
+            epoch=self._epoch,
+        )
+        packer = SequencePacker(
+            self.max_seq_len, max_segments=self.max_segments,
+            open_rows=self.open_rows,
+        )
+        rows: List[list] = []  # each row: list of (index, length)
+        for idx, length in zip(indices, lengths):
+            rows.extend(packer.add((idx, length), length))
+        rows.extend(packer.flush())
+
+        rpb = self.rows_per_batch
+        local_rows = rpb // self.process_count
+        lo = self.process_index * local_rows
+        ek = _oracle_epoch_key(self.dataset, self._epoch)
+
+        batches = [
+            (rows[b * rpb:(b + 1) * rpb], rpb)
+            for b in range(len(rows) // rpb)
+        ]
+        tail = rows[(len(rows) // rpb) * rpb:]
+        if tail:
+            if self.pad_last:
+                batches.append((tail, len(tail)))
+            else:
+                stats["dropped_items"] += sum(len(r) for r in tail)
+                logger.info(
+                    "Packed epoch dropped %d tail items in %d partial-batch "
+                    "rows (drop_last parity; they re-enter next epoch's "
+                    "shuffle).",
+                    stats["dropped_items"], len(tail),
+                )
+
+        def local_slice(batch_rows):
+            # pad the global tail by repeating the last REAL row (eval
+            # pad_last contract), then take this host's contiguous slice
+            padded = batch_rows + [batch_rows[-1]] * (rpb - len(batch_rows))
+            return padded[lo:lo + local_rows]
+
+        def submit(pool, batch_rows):
+            return [
+                [
+                    pool.submit(
+                        oracle_read, self.dataset, idx,
+                        retries=self.read_retries, epoch=ek,
+                    )
+                    for idx, _ in row
+                ]
+                for row in local_slice(batch_rows)
+            ]
+
+        def emit_global(batch_rows, real_rows, row_items):
+            inputs, labels = collate_packed(
+                row_items, self.tokenizer, max_seq_len=self.max_seq_len,
+                max_segments=self.max_segments,
+            )
+            # zero the mask of LOCAL rows that are global pad rows
+            for r in range(local_rows):
+                if lo + r >= real_rows:
+                    labels["segment_mask"][r] = 0
+            real_items = [it for row in batch_rows[:real_rows] for it in row]
+            stats["real_tokens"] += sum(length for _, length in real_items)
+            stats["physical_tokens"] += rpb * self.max_seq_len
+            stats["padmax_tokens"] += len(real_items) * self.max_seq_len
+            stats["rows"] += real_rows
+            stats["batches"] += 1
+            stats["items"] += len(real_items)
+            # GLOBAL segment count: what row-weighted metrics key on
+            segments = sum(len(row) for row in batch_rows[:real_rows])
+            return PackedBatch(
+                inputs=inputs, labels=labels, rows=rpb, segments=segments,
+                seq=self.max_seq_len,
+            )
+
+        # ONE pool for the epoch, reads submitted a batch ahead: the next
+        # batch's item reads overlap this batch's collate and the device
+        # step, mirroring the single-process path's sliding read window
+        with ThreadPoolExecutor(max_workers=self.n_jobs) as pool:
+            pending: deque = deque()
+            for i in range(min(2, len(batches))):
+                pending.append(submit(pool, batches[i][0]))
+            for i, (batch_rows, real_rows) in enumerate(batches):
+                futures = pending.popleft()
+                if i + 2 < len(batches):
+                    pending.append(submit(pool, batches[i + 2][0]))
+                row_items = [[f.result() for f in row] for row in futures]
+                yield emit_global(batch_rows, real_rows, row_items)
+
     def __iter__(self):
+        if self.process_count > 1:
+            yield from self._iter_oracle()
+            return
         indices = [int(i) for i in self.sampler.epoch_indices(self._epoch)]
         self._last_stats = stats = {
             "real_tokens": 0,

@@ -19,9 +19,10 @@ feeds only its slice, SURVEY.md §7).
 from __future__ import annotations
 
 import re
-from typing import Dict, Optional
+from typing import Dict, NamedTuple, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -70,6 +71,71 @@ def param_pspecs(params, mesh: Mesh) -> dict:
     return jax.tree_util.tree_map_with_path(spec_for, params)
 
 
+class ZeroLeafPlan(NamedTuple):
+    """Per-leaf ZeRO-1 placement: ``spec`` is the PartitionSpec of the
+    (possibly padded) stored leaf; ``axis``/``padded`` name the dim carrying
+    the ``data`` axis and its padded extent (``axis is None`` = replicated,
+    ``padded == shape[axis]`` = no padding was needed)."""
+
+    spec: P
+    axis: Optional[int]
+    padded: Optional[int]
+
+
+def _zero_leaf_plan(path, shape, *, data_size: int,
+                    has_tp: bool, min_size) -> ZeroLeafPlan:
+    """The ONE dim chooser every ZeRO-1 consumer shares (state shardings,
+    gradient constraints, byte modeling, checkpoint reconciliation):
+    tensor-parallel axes are honored first; the ``data`` axis then lands on
+    the largest remaining dim already divisible by the axis size — or, when
+    none divides, on the largest remaining dim PADDED up to the next
+    multiple (this JAX rejects uneven shardings, so divisibility is bought
+    with explicit zero padding of the stored state). Leaves below
+    ``min_size`` elements (and scalars) stay replicated: sharding them buys
+    nothing and costs collective latency."""
+    axes = [None] * len(shape)
+    if has_tp:
+        path_s = _path_str(path)
+        for pattern, spec in TP_RULES:
+            if re.match(pattern, path_s):
+                axes = list(spec) + [None] * (len(shape) - len(spec))
+                break
+    if data_size <= 1 or int(np.prod(shape or (0,))) < min_size:
+        return ZeroLeafPlan(P(*axes), None, None)
+    free = [(dim, i) for i, dim in enumerate(shape) if axes[i] is None]
+    divisible = [(dim, i) for dim, i in free if dim % data_size == 0]
+    if divisible:
+        dim, i = max(divisible)
+        padded = dim
+    elif free and max(free)[0] >= 2:
+        dim, i = max(free)
+        padded = -(-dim // data_size) * data_size  # ceil to a multiple
+    else:
+        return ZeroLeafPlan(P(*axes), None, None)
+    axes[i] = DATA_AXIS
+    return ZeroLeafPlan(P(*axes), i, padded)
+
+
+def zero1_plan(tree, mesh: Mesh, *, min_size: int = 16384):
+    """ZeRO-1 placement plan for a (shape-carrying) pytree: one
+    :class:`ZeroLeafPlan` per leaf. Works on live arrays and on
+    ``jax.eval_shape`` outputs alike — only ``.shape`` is read. Leaf paths
+    inside optax states end with the param path (e.g.
+    ``.../mu/encoder/layer_0/attention/query/kernel``), so the tensor-
+    parallel rules apply unchanged."""
+    data_size = int(mesh.shape.get(DATA_AXIS, 1))
+    has_tp = MODEL_AXIS in mesh.axis_names and mesh.shape[MODEL_AXIS] > 1
+
+    def plan_for(path, leaf):
+        shape = tuple(getattr(leaf, "shape", ()))
+        return _zero_leaf_plan(
+            path, shape, data_size=data_size, has_tp=has_tp,
+            min_size=min_size,
+        )
+
+    return jax.tree_util.tree_map_with_path(plan_for, tree)
+
+
 def zero_pspecs(state_shapes, mesh: Mesh, *, min_size: int = 16384):
     """ZeRO-1 PartitionSpec tree for an optimizer-state (shape) tree.
 
@@ -77,39 +143,111 @@ def zero_pspecs(state_shapes, mesh: Mesh, *, min_size: int = 16384):
     §2.3 'full replica optimizer state'); here each moment tensor is sharded
     over the ``data`` axis so its memory scales 1/N with data parallelism —
     XLA all-gathers the (sharded) param updates it produces, which is the
-    ZeRO-1 communication pattern.
-
-    Works on the output of ``jax.eval_shape(optimizer.init, params)``. Leaf
-    paths inside optax states end with the param path (e.g.
-    ``.../mu/encoder/layer_0/attention/query/kernel``), so the tensor-
-    parallel rules apply unchanged; the data axis is then laid on the
-    largest remaining dim divisible by its size. Small leaves (< min_size
-    elements, e.g. biases and scalars like ``count``) stay replicated —
-    sharding them buys nothing and costs collective latency.
+    ZeRO-1 communication pattern. The specs assume the leaves are already at
+    their PADDED extents (``zero_pad_tree``) where the plan demands padding.
     """
-    data_size = mesh.shape.get(DATA_AXIS, 1)
-    has_tp = MODEL_AXIS in mesh.axis_names and mesh.shape[MODEL_AXIS] > 1
+    return jax.tree_util.tree_map(
+        lambda z: z.spec, zero1_plan(state_shapes, mesh, min_size=min_size),
+        is_leaf=lambda x: isinstance(x, ZeroLeafPlan),
+    )
 
-    def spec_for(path, leaf):
+
+def zero_pad_tree(tree, plan):
+    """Zero-pad each leaf along its plan axis up to the padded extent (the
+    divisibility the ``data``-axis sharding needs). No-op leaves (plan axis
+    None, or already divisible) pass through untouched — jnp.pad with a
+    zero width is the identity, so the padded update step costs nothing on
+    the (typical) leaves whose dims already divide."""
+
+    def pad(x, z):
+        if z.axis is None or z.padded == x.shape[z.axis]:
+            return x
+        widths = [(0, 0)] * x.ndim
+        widths[z.axis] = (0, z.padded - x.shape[z.axis])
+        return jnp.pad(x, widths)
+
+    return jax.tree_util.tree_map(
+        pad, tree, plan, is_leaf=lambda x: isinstance(x, ZeroLeafPlan)
+    )
+
+
+def zero_unpad_tree(tree, plan, logical):
+    """Slice padded leaves back to the logical shapes of ``logical`` (a
+    shape-carrying twin tree) — the inverse of :func:`zero_pad_tree`."""
+
+    def unpad(x, z, ref):
+        shape = tuple(ref.shape)
+        if z.axis is None or tuple(x.shape) == shape:
+            return x
+        return jax.lax.slice(x, (0,) * x.ndim, shape)
+
+    return jax.tree_util.tree_map(
+        unpad, tree, plan, logical,
+        is_leaf=lambda x: isinstance(x, ZeroLeafPlan),
+    )
+
+
+def opt_state_bytes_per_chip(opt_state) -> int:
+    """MEASURED per-device resident bytes of a live optimizer-state tree:
+    each leaf contributes one shard's bytes (its sharding's per-device
+    shard shape), so a ZeRO-sharded state reports ~1/N of its replicated
+    footprint. Host (numpy) leaves count in full — they are replicated by
+    construction."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(opt_state):
+        shape = tuple(np.shape(leaf))
+        itemsize = np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None:
+            try:
+                shape = tuple(sharding.shard_shape(shape))
+            except Exception:  # noqa: BLE001 - exotic sharding: count full
+                pass
+        total += int(np.prod(shape or (1,), dtype=np.int64)) * itemsize
+    return total
+
+
+def zero1_state_bytes(state_shapes, *, data_size: int,
+                      min_size: int = 16384) -> dict:
+    """MODELED optimizer-state bytes per chip at an arbitrary data-axis
+    size — no mesh, no devices, no compile: the HBM-planning probe
+    (``bench.py --param_count_probe``) runs this before a TPU window opens.
+
+    Returns ``replicated_bytes`` (every leaf in full — the historical
+    layout), ``zero1_bytes`` (each plan-sharded leaf at its padded extent
+    divided over ``data_size``, the rest in full) and ``sharded_bytes``
+    (the replicated footprint of exactly the leaves the plan shards — the
+    ``(N-1)/N`` savings base the acceptance math is stated against).
+    """
+    data_size = max(1, int(data_size))
+
+    def leaf_info(path, leaf):
         shape = tuple(getattr(leaf, "shape", ()))
-        axes = [None] * len(shape)
-        if has_tp:
-            path_s = _path_str(path)
-            for pattern, spec in TP_RULES:
-                if re.match(pattern, path_s):
-                    axes = list(spec) + [None] * (len(shape) - len(spec))
-                    break
-        if data_size > 1 and int(np.prod(shape or (0,))) >= min_size:
-            free = [
-                (dim, i) for i, dim in enumerate(shape)
-                if axes[i] is None and dim % data_size == 0
-            ]
-            if free:
-                _, i = max(free)
-                axes[i] = DATA_AXIS
-        return P(*axes)
+        dtype = np.dtype(getattr(leaf, "dtype", np.float32))
+        z = _zero_leaf_plan(
+            path, shape, data_size=data_size, has_tp=False,
+            min_size=min_size,
+        )
+        full = int(np.prod(shape or (1,), dtype=np.int64)) * dtype.itemsize
+        if z.axis is None:
+            return full, full, 0
+        padded = list(shape)
+        padded[z.axis] = z.padded
+        shard = list(padded)
+        shard[z.axis] = z.padded // data_size
+        shard_bytes = int(np.prod(shard, dtype=np.int64)) * dtype.itemsize
+        return full, shard_bytes, full
 
-    return jax.tree_util.tree_map_with_path(spec_for, state_shapes)
+    infos = [
+        leaf_info(path, leaf)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(state_shapes)[0]
+    ]
+    return {
+        "data_size": data_size,
+        "replicated_bytes": sum(i[0] for i in infos),
+        "zero1_bytes": sum(i[1] for i in infos),
+        "sharded_bytes": sum(i[2] for i in infos),
+    }
 
 
 def is_single_device(mesh: Mesh) -> bool:

@@ -2,8 +2,10 @@ from .optim import build_optimizer, adamod, linear_warmup_schedule
 from .trainer import Trainer
 from .callback import TestCallback, AccuracyCallback, MAPCallback, SaveBestCallback
 from .checkpoint import (
+    CheckpointLayoutError,
     TornCheckpointError,
     load_state_dict,
+    peek_checkpoint_layout,
     peek_global_step,
     save_state_dict,
 )
@@ -21,7 +23,9 @@ __all__ = [
     "save_state_dict",
     "load_state_dict",
     "peek_global_step",
+    "peek_checkpoint_layout",
     "TornCheckpointError",
+    "CheckpointLayoutError",
     "SummaryWriter",
     "init_writer",
 ]

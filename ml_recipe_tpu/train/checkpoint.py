@@ -40,6 +40,14 @@ class TornCheckpointError(RuntimeError):
     interrupted mid-write, or shard files are missing)."""
 
 
+class CheckpointLayoutError(RuntimeError):
+    """The checkpoint's recorded state layout does not match the restore
+    target (different optimizer chain, missing/unexpected leaves, or
+    incompatible leaf ranks). Raised BEFORE any tensor is restored, with
+    the expected-vs-found layouts in the message — the alternative is an
+    opaque shape/structure error halfway through the restore."""
+
+
 def save_state_dict(
     path,
     *,
@@ -206,6 +214,31 @@ def peek_global_step(path) -> Optional[int]:
         return None
 
 
+def _global_shard_count(arr) -> int:
+    """Number of DISTINCT data shards of an array across the whole mesh
+    (replicas collapse to one): 1 for replicated/host leaves, N for a
+    ZeRO-1 leaf sharded N ways. Computed from sharding METADATA only —
+    every process knows the full device->index map without touching remote
+    data, which is what lets the manifest record the layout without a
+    gather."""
+    import jax
+
+    if not isinstance(arr, jax.Array):
+        return 1
+    try:
+        index_map = arr.sharding.devices_indices_map(tuple(arr.shape))
+    except Exception:  # noqa: BLE001 - exotic sharding: report unknown as 1
+        return 1
+    distinct = {
+        tuple(
+            (int(s.start or 0), int(s.stop if s.stop is not None else dim))
+            for s, dim in zip(idx, arr.shape)
+        )
+        for idx in index_map.values()
+    }
+    return max(1, len(distinct))
+
+
 def _flat_state(tree) -> dict:
     """State-dict tree flattened to ``{'a/b/c': leaf}`` (leaves untouched —
     jax.Arrays keep their shardings). Empty subtrees (optax EmptyState
@@ -214,6 +247,113 @@ def _flat_state(tree) -> dict:
     sd = serialization.to_state_dict(tree)
     flat = flatten_dict(sd, keep_empty_nodes=True)
     return {"/".join(map(str, k)): v for k, v in flat.items()}
+
+
+def peek_checkpoint_layout(path) -> Optional[dict]:
+    """Shard layout of the checkpoint at ``path`` WITHOUT loading tensors,
+    or None when there is no readable checkpoint there.
+
+    For a sharded directory only the manifest is read: ``shards`` is the
+    widest per-leaf sharding recorded at save time (1 = fully replicated
+    state, N = a ZeRO-1 save over an N-way data axis), ``opt_sharding``
+    the saver's ``--optimizer_sharding`` mode when it recorded one.
+    Single-file checkpoints are by construction one replicated shard —
+    and msgpack has no lazy skip, so peeking one costs a full deserialize
+    (exactly like :func:`peek_global_step` on the same file); the cheap
+    no-tensor peek is a property of the sharded-directory format.
+    The companion of :func:`peek_global_step` — what the supervisor and
+    operators consult before deciding whether a checkpoint can be resumed
+    on the current topology (it always can; this tells them what resharding
+    the load will perform)."""
+    path = os.fspath(path)
+    if not os.path.exists(path):
+        _recover_interrupted_swap(path, path + ".saving", path + ".old")
+    if not os.path.exists(path):
+        return None
+    try:
+        if os.path.isdir(path):
+            manifest_path = os.path.join(path, _MANIFEST)
+            if not os.path.exists(manifest_path):
+                return None
+            with open(manifest_path, "rb") as fh:
+                manifest = serialization.msgpack_restore(fh.read())
+            groups = manifest.get("groups", {})
+            return {
+                "format": "sharded",
+                "global_step": int(manifest.get("global_step", 0)),
+                "process_count": int(manifest.get("process_count", 1)),
+                "shards": int(manifest.get("shards", 1)),
+                "opt_sharding": (manifest.get("extra") or {}).get(
+                    "opt_sharding"
+                ),
+                "groups": {g: len(leaves) for g, leaves in groups.items()},
+            }
+        with open(path, "rb") as fh:
+            state = serialization.msgpack_restore(fh.read())
+        return {
+            "format": "single_file",
+            "global_step": int(state.get("global_step", 0)),
+            "process_count": 1,
+            "shards": 1,
+            "opt_sharding": state.get("opt_sharding"),
+            "groups": {
+                g: len(flatten_dict(state[g], keep_empty_nodes=True))
+                for g in ("model", "optimizer", "loss_scale")
+                if isinstance(state.get(g), dict)
+            },
+        }
+    except Exception as e:  # noqa: BLE001 - torn/corrupt == not resumable
+        logger.warning(f"Could not peek checkpoint layout from {path}: {e!r}")
+        return None
+
+
+def _verify_group_layout(manifest, gname: str, target, path) -> None:
+    """Pre-restore layout check of one manifest group against its restore
+    target: the leaf KEY SETS must coincide and common leaves must agree on
+    rank. Shape differences at equal rank are legal — that is exactly what
+    a ZeRO-1 mesh-shape change looks like (padded extents differ with N;
+    the Trainer crops/zero-fills onto the live layout) — and are logged,
+    not raised. Anything else raises :class:`CheckpointLayoutError` with
+    the expected-vs-found layout instead of letting flax die on a shape or
+    structure error halfway through the restore."""
+    found = manifest["groups"][gname]
+    expected = _flat_state(target)
+    missing = sorted(k for k in expected if k not in found)
+    unexpected = sorted(k for k in found if k not in expected)
+    if missing or unexpected:
+        raise CheckpointLayoutError(
+            f"checkpoint {path} group '{gname}' does not match the restore "
+            f"target's layout (saved with shards={manifest.get('shards', 1)}"
+            f", opt_sharding="
+            f"{(manifest.get('extra') or {}).get('opt_sharding')!r}): "
+            f"target expects {len(expected)} leaves, checkpoint holds "
+            f"{len(found)}; missing from checkpoint: {missing or 'none'}; "
+            f"unexpected in checkpoint: {unexpected or 'none'}"
+        )
+    resharded = []
+    for key, meta in found.items():
+        if meta.get("empty") or expected[key] is empty_node:
+            continue
+        want_shape = tuple(np.shape(expected[key]))
+        got_shape = tuple(meta.get("shape", ()))
+        if len(want_shape) != len(got_shape):
+            raise CheckpointLayoutError(
+                f"checkpoint {path} group '{gname}' leaf '{key}' rank "
+                f"mismatch: target expects shape {want_shape}, checkpoint "
+                f"holds {got_shape} (saved with "
+                f"shards={meta.get('shards', 1)}) — a different "
+                f"model/optimizer layout, not a mesh-shape change"
+            )
+        if want_shape != got_shape:
+            resharded.append((key, got_shape, want_shape))
+    if resharded:
+        logger.info(
+            "Checkpoint %s group '%s': %d leaves change padded extent "
+            "across the restore (ZeRO-1 mesh-shape change, e.g. %s %s -> "
+            "%s); the trainer crops/zero-fills onto the live layout.",
+            path, gname, len(resharded), resharded[0][0], resharded[0][1],
+            resharded[0][2],
+        )
 
 
 def save_state_dict_sharded(
@@ -317,6 +457,10 @@ def save_state_dict_sharded(
             leaves_meta[key] = {
                 "shape": list(np.shape(arr)),
                 "dtype": str(np.dtype(dtype)),
+                # shard layout (ZeRO-1 manifest clause): how many distinct
+                # pieces this leaf is stored as across the mesh — readable
+                # without loading a single tensor (peek_checkpoint_layout)
+                "shards": _global_shard_count(arr),
             }
             group_out = owned.setdefault(gname, {})
             if isinstance(arr, jax.Array):
@@ -359,6 +503,25 @@ def save_state_dict_sharded(
                     [(p["bounds"], p["crc32"]) for p in pieces]
                 )
         manifest["groups"][gname] = leaves_meta
+
+    # headline layout field: the widest sharding of the OPTIMIZER state
+    # (1 = fully replicated; N = a ZeRO-1 save over an N-way data axis) —
+    # what `peek_checkpoint_layout` reports without loading tensors. Scoped
+    # to the optimizer group deliberately: a tensor-parallel mesh shards
+    # MODEL leaves too, and counting those would misreport a replicated-
+    # optimizer TP save as ZeRO-1 (per-leaf `shards` still records every
+    # group's true piece counts). Falls back to the model group's count
+    # when no optimizer state was saved (params-only checkpoints).
+    def _group_shards(gname):
+        return [
+            int(meta.get("shards", 1))
+            for meta in manifest["groups"].get(gname, {}).values()
+            if not meta.get("empty")
+        ]
+
+    manifest["shards"] = max(
+        _group_shards("optimizer") or _group_shards("model") or [1]
+    )
 
     # each shard file still carries the step as defense-in-depth torn-save
     # detection (e.g. a checkpoint directory assembled by hand)
@@ -509,6 +672,11 @@ def load_state_dict_sharded(
                     )
 
     def _restore(target, gname):
+        # layout first, tensors second: a mismatched optimizer chain or
+        # model fails here with the expected-vs-found layout (and the
+        # manifest's shard counts), not with a flax structure/shape error
+        # halfway through assembling values
+        _verify_group_layout(manifest, gname, target, path)
         flat = dict(assembled[gname])
         for key, meta in manifest["groups"][gname].items():
             if meta.get("empty"):

@@ -154,12 +154,32 @@ def _scale_by_adam_no_bias_correction(
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+def _path_names(path) -> list:
+    """Key names along one pytree path, as plain strings (DictKey /
+    SequenceKey / attr entries normalized alike)."""
+    return [str(getattr(p, "key", getattr(p, "idx", p))) for p in path]
+
+
+def param_path_mask(params, predicate) -> dict:
+    """THE shared path walk of every per-parameter boolean mask: one
+    boolean leaf per param leaf, ``predicate(names)`` over the leaf's path
+    names. ``no_decay_mask`` and ``trainable_mask`` used to each walk the
+    tree with their own path-string plumbing, which let the two masks
+    disagree on how a new leaf's path reads (and therefore on its
+    membership); deriving both from this single walk makes their tree
+    structure identical by construction — which is also what lets them
+    compose with the ZeRO-1 state plan (parallel/sharding.zero1_plan),
+    itself keyed by the same path names."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: bool(predicate(_path_names(path))), params
+    )
+
+
 def no_decay_mask(params) -> dict:
     """True where weight decay applies — everything except biases and
     LayerNorm scales/biases (reference init.py:125-129 no_decay groups)."""
 
-    def decays(path, leaf):
-        names = [str(getattr(p, "key", p)) for p in path]
+    def decays(names):
         leaf_name = names[-1] if names else ""
         if leaf_name == "bias":
             return False
@@ -167,7 +187,7 @@ def no_decay_mask(params) -> dict:
             return False
         return True
 
-    return jax.tree_util.tree_map_with_path(decays, params)
+    return param_path_mask(params, decays)
 
 
 def trainable_mask(params, trainer_params) -> Optional[dict]:
@@ -189,11 +209,33 @@ def trainable_mask(params, trainer_params) -> Optional[dict]:
     if not wanted_roots:
         raise AttributeError("Specify at least one module for fine-tuning.")
 
-    def trainable(path, leaf):
-        root = str(getattr(path[0], "key", path[0]))
-        return root in wanted_roots
+    return param_path_mask(
+        params, lambda names: bool(names) and names[0] in wanted_roots
+    )
 
-    return jax.tree_util.tree_map_with_path(trainable, params)
+
+OPTIMIZER_SHARDING_MODES = ("off", "zero1")
+
+
+def parse_optimizer_sharding(spec, *, shard_optimizer=None) -> str:
+    """Flag domain of ``--optimizer_sharding``: ``off`` (replicate the full
+    optimizer state per chip — the historical layout) or ``zero1`` (shard
+    every state leaf over the mesh ``data`` axis and run the weight update
+    on each replica's shard only). ``None`` defers to the legacy
+    ``--shard_optimizer`` boolean so existing configs keep working."""
+    if spec is None:
+        return "zero1" if shard_optimizer else "off"
+    mode = str(spec).strip().lower()
+    if mode in ("", "none", "false", "0"):
+        return "off"
+    if mode in ("true", "1", "on"):
+        return "zero1"
+    if mode not in OPTIMIZER_SHARDING_MODES:
+        raise ValueError(
+            f"bad optimizer_sharding {spec!r} (choose from "
+            f"{'|'.join(OPTIMIZER_SHARDING_MODES)})"
+        )
+    return mode
 
 
 def build_optimizer(
@@ -203,6 +245,7 @@ def build_optimizer(
     num_training_steps: int,
     max_grad_norm: Optional[float] = None,
     warmup_coef: Optional[float] = None,
+    optimizer_sharding: Optional[str] = None,
 ) -> tuple:
     """Optimizer selection + schedule (reference init.py:134-145 +
     trainer.py:116-126 + clip trainer.py:221-225 fused into one chain).
@@ -216,7 +259,22 @@ def build_optimizer(
     state, count included). ``warmup_coef``, when given, overrides
     ``trainer_params.warmup_coef`` (the Trainer field is the single source
     of truth when built through the Trainer).
+
+    ``optimizer_sharding`` (``off``/``zero1``; ``None`` defers to
+    ``trainer_params.optimizer_sharding`` / the legacy ``shard_optimizer``
+    boolean) is validated HERE — the chain's transforms are layout-agnostic
+    (elementwise over whatever leaves they are given), so the actual state
+    placement and the reduce-scatter/all-gather update pattern are applied
+    where the state is materialized: ``Trainer.init_opt_state`` and the
+    jitted train step. A bad mode must still fail at build time, not at the
+    first step.
     """
+    parse_optimizer_sharding(
+        optimizer_sharding
+        if optimizer_sharding is not None
+        else getattr(trainer_params, "optimizer_sharding", None),
+        shard_optimizer=getattr(trainer_params, "shard_optimizer", False),
+    )
     if warmup_coef is None:
         warmup_coef = getattr(trainer_params, "warmup_coef", 0.0)
     lr = trainer_params.lr

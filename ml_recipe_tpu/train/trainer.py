@@ -53,7 +53,13 @@ from ..losses import PackedWeightedLoss
 from ..metrics import AverageMeter
 from ..resilience.faults import fire as _fault
 from ..parallel import build_mesh, gather_to_host, make_global_array, shard_params
-from ..parallel.sharding import is_single_device, split_micro
+from ..parallel.sharding import (
+    is_single_device,
+    opt_state_bytes_per_chip,
+    split_micro,
+    zero_pad_tree,
+    zero_unpad_tree,
+)
 from ..utils.hbm import device_hbm_bytes, preflight_bytes
 from ..utils.pipeline import LaggedConsumer
 from ..utils.profiler import time_profiler
@@ -100,6 +106,37 @@ def resolve_prefetch_auto(place_s, step_s, *, threshold: float = 0.05) -> int:
 # Private aliases keep this module's historical names importable.
 _device_hbm_bytes = device_hbm_bytes
 _preflight_bytes = preflight_bytes
+
+
+def reconcile_state_shapes(restored, live):
+    """Reshard a restored (host) optimizer-state tree onto the LIVE leaf
+    shapes: ``zero1`` stores each sharded leaf zero-padded to its mesh
+    data-axis multiple, so a checkpoint taken at mesh N restores at mesh M
+    (M != N) — or under ``--optimizer_sharding off``, or vice versa — by
+    corner-cropping every leaf to the shape overlap and zero-filling the
+    live padding. The pad region is zeros by construction (padded gradients
+    are zero there, so Adam moments never leave zero) and never feeds a
+    real element's update, which is what makes this crop/fill exact rather
+    than approximate."""
+
+    def fix(saved, live_leaf):
+        target = tuple(np.shape(live_leaf))
+        arr = np.asarray(saved)
+        if tuple(arr.shape) == target:
+            return saved
+        if arr.ndim != len(target):
+            raise ValueError(
+                f"optimizer-state leaf rank changed across restore: saved "
+                f"{arr.shape} vs live {target} — this is a layout mismatch "
+                f"(different optimizer chain?), not ZeRO padding"
+            )
+        arr = arr[tuple(slice(0, min(s, t)) for s, t in zip(arr.shape, target))]
+        widths = [(0, t - s) for s, t in zip(arr.shape, target)]
+        if any(w for _, w in widths):
+            arr = np.pad(arr, widths)
+        return arr
+
+    return jax.tree_util.tree_map(fix, restored, live)
 
 
 def _console_str(meters: dict) -> str:
@@ -158,6 +195,10 @@ class Trainer:
     # ZeRO-1: shard optimizer moments over the mesh data axis (memory 1/N;
     # the reference keeps a full replica per process, SURVEY.md §2.3). XLA
     # all-gathers the sharded param updates — the ZeRO-1 pattern.
+    # `optimizer_sharding` is the public mode ('off'|'zero1', the
+    # --optimizer_sharding flag); None defers to the legacy
+    # `shard_optimizer` boolean so existing callers keep working.
+    optimizer_sharding: Any = None
     shard_optimizer: bool = False
     zero_min_size: int = 16384      # leaves smaller than this stay replicated
 
@@ -237,6 +278,15 @@ class Trainer:
         self.process_index = jax.process_index()
         self.process_count = jax.process_count()
         self.is_primary = self.process_index == 0
+
+        # resolve the optimizer-state layout once; 'zero1' is what the
+        # --optimizer_sharding flag threads down, the shard_optimizer bool
+        # is the legacy spelling
+        from .optim import parse_optimizer_sharding
+
+        self.opt_sharding_mode = parse_optimizer_sharding(
+            self.optimizer_sharding, shard_optimizer=self.shard_optimizer
+        )
 
         if self.debug:
             self.n_epochs = 2
@@ -363,6 +413,8 @@ class Trainer:
         self._schedule_count = None
         self._planned_steps_per_epoch = None
         self._zero_shardings = None
+        self._zero_plan = None
+        self._zero_param_shardings = None
         self._use_loss_scale = False
         if self.train_dataloader is not None and self.trainer_params is not None:
             micro_batch = self.train_batch_size // self.batch_split
@@ -406,6 +458,7 @@ class Trainer:
                 num_training_steps=num_training_steps,
                 max_grad_norm=None,
                 warmup_coef=self.warmup_coef,
+                optimizer_sharding=self.opt_sharding_mode,
             )
             if getattr(self.trainer_params, "sync_bn", False):
                 # Reference converts BatchNorm -> SyncBN (trainer.py:89-95).
@@ -450,32 +503,69 @@ class Trainer:
         self._preflight_done = not self.hbm_preflight
         self.preflight_report = None
 
+    def zero_enabled(self) -> bool:
+        """True when the resolved layout is ``zero1`` AND the mesh has a
+        multi-way data axis to shard over (a 1-chip 'zero1' run takes the
+        replicated path bit-exactly — there is nothing to shard)."""
+        return (
+            self.opt_sharding_mode == "zero1"
+            and not is_single_device(self.mesh)
+            and int(self.mesh.shape.get("data", 1)) > 1
+        )
+
+    @property
+    def effective_opt_sharding(self) -> str:
+        """The layout the state ACTUALLY lives in — 'zero1' only when the
+        mesh lets it shard; a requested-but-inert zero1 (1-chip mesh)
+        reports 'off'. The one spelling every report/manifest/bench field
+        uses."""
+        return self.opt_sharding_mode if self.zero_enabled() else "off"
+
     def init_opt_state(self):
         """(Re)initialize ``opt_state`` from ``self.optimizer``, honoring
-        ``shard_optimizer`` (ZeRO-1). Also used by callers that build the
-        optimizer themselves (bench, dry-run).
+        ``optimizer_sharding`` (ZeRO-1). Also used by callers that build
+        the optimizer themselves (bench, dry-run).
+
+        Under ``zero1`` every state leaf is laid out by the padding-aware
+        per-leaf plan (parallel/sharding.zero1_plan): the ``data`` axis
+        lands on the largest divisible dim, or the leaf is zero-padded up
+        to the next multiple when none divides — so the stored state is
+        genuinely 1/N per chip, not "1/N where divisibility allowed".
 
         Placement is always EXPLICIT on multi-device meshes:
         ``optimizer.init`` reads only param shapes, so XLA prunes the param
         arguments and without ``out_shardings`` every leaf (scalars like
         ``count`` included) would land committed to the default device.
         """
-        use_zero = (
-            self.shard_optimizer
-            and not is_single_device(self.mesh)
-            and int(self.mesh.shape.get("data", 1)) > 1
-        )
+        use_zero = self.zero_enabled()
         if is_single_device(self.mesh):
             self._zero_shardings = None
+            self._zero_plan = None
+            self._zero_param_shardings = None
             self.opt_state = jax.jit(self.optimizer.init)(self.params)
             self._bundle_ls()
             return
 
         import math
 
-        from ..parallel.sharding import zero_pspecs
+        from ..parallel.sharding import ZeroLeafPlan, zero1_plan, zero_pspecs
 
-        state_shapes = jax.eval_shape(self.optimizer.init, self.params)
+        if use_zero:
+            plan = zero1_plan(
+                self.params, self.mesh, min_size=self.zero_min_size
+            )
+            self._zero_plan = plan
+            self._zero_param_shardings = jax.tree_util.tree_map(
+                lambda z: NamedSharding(self.mesh, z.spec), plan,
+                is_leaf=lambda x: isinstance(x, ZeroLeafPlan),
+            )
+            init_fn = lambda p: self.optimizer.init(zero_pad_tree(p, plan))
+        else:
+            self._zero_plan = None
+            self._zero_param_shardings = None
+            init_fn = self.optimizer.init
+
+        state_shapes = jax.eval_shape(init_fn, self.params)
         shardings = jax.tree_util.tree_map(
             lambda spec: NamedSharding(self.mesh, spec),
             zero_pspecs(
@@ -487,10 +577,15 @@ class Trainer:
         )
         self._zero_shardings = shardings if use_zero else None
         self.opt_state = jax.jit(
-            self.optimizer.init, out_shardings=shardings
+            init_fn, out_shardings=shardings
         )(self.params)
         if use_zero:
-            logger.info("ZeRO-1: optimizer state sharded over the data axis.")
+            logger.info(
+                "ZeRO-1: optimizer state sharded over the %d-way data axis "
+                "(%.1f MB per chip).",
+                int(self.mesh.shape.get("data", 1)),
+                opt_state_bytes_per_chip(self.opt_state) / 1e6,
+            )
         self._bundle_ls()
 
     def _prefetch_auto(self) -> bool:
@@ -534,20 +629,19 @@ class Trainer:
         return split_micro(tree, self.batch_split)
 
     def _resolve_packing(self) -> bool:
-        """Normalize ``sequence_packing``; multi-host runs fall back to the
-        pad-to-max path with a warning (row composition is length-dependent
-        and step shapes would diverge across hosts, exactly the bucketing
-        constraint); with ``length_buckets`` also set, packing wins (it
-        subsumes the bucketed padding win) with a log line."""
+        """Normalize ``sequence_packing``; with ``length_buckets`` also
+        set, packing wins (it subsumes the bucketed padding win) with a log
+        line. Multi-host runs are first-class: the loaders derive every
+        host's identical pack plan from the shared length oracle
+        (data/packing.oracle_read), so step shapes stay in lockstep."""
         if not parse_sequence_packing(self.sequence_packing):
             return False
         if self.process_count > 1:
-            logger.warning(
-                "sequence_packing: packing is single-process (length-"
-                "dependent row composition would diverge step shapes "
-                "across hosts); falling back to pad-to-max batching."
+            logger.info(
+                "sequence_packing: multi-host run — the per-epoch pack "
+                "plan derives from the shared length oracle, each host "
+                "collates its row slice."
             )
-            return False
         if self.collate_fun is None or self._collate_tokenizer() is None:
             logger.warning(
                 "sequence_packing needs a tokenizer-bound collate_fun "
@@ -605,18 +699,18 @@ class Trainer:
     def _resolve_seq_grid(self):
         """Normalized sorted bucket grid from ``length_buckets`` (or None).
         Extended to cover the collate's static max_seq_len (an item longer
-        than every bucket would have nowhere to go); multi-host runs fall
-        back to pad-to-max with a warning (see BucketedDataLoader)."""
+        than every bucket would have nowhere to go). Multi-host runs are
+        first-class: every host derives the identical bucket plan from the
+        shared length oracle (see BucketedDataLoader)."""
         buckets = self.length_buckets
         if not buckets:
             return None
         if self.process_count > 1:
-            logger.warning(
-                "length_buckets: bucketed batching is single-process "
-                "(length-dependent batch shapes would diverge across "
-                "hosts); falling back to pad-to-max batching."
+            logger.info(
+                "length_buckets: multi-host run — the per-epoch bucket "
+                "plan derives from the shared length oracle, each host "
+                "collates its row slice."
             )
-            return None
         from ..data.bucketing import parse_length_buckets
 
         # one normalizer for every entry point: sort/dedupe/validate and
@@ -693,6 +787,16 @@ class Trainer:
             "bytes_before": None,
             "bytes": None,
             "applied": False,
+            # optimizer-state residency: under zero1 this is ~1/N of the
+            # replicated footprint, which is exactly why the planner must
+            # re-measure rather than keep raising batch_split for memory
+            # that no longer exists
+            "opt_sharding": self.effective_opt_sharding,
+            "opt_state_bytes_per_chip": (
+                opt_state_bytes_per_chip(self.opt_state)
+                if self.opt_state is not None
+                else None
+            ),
         }
         while True:
             if self._jit_train_step is None:
@@ -795,6 +899,12 @@ class Trainer:
             "batch_split": self.batch_split,
             "buckets": [],
             "applied": False,
+            "opt_sharding": self.effective_opt_sharding,
+            "opt_state_bytes_per_chip": (
+                opt_state_bytes_per_chip(self.opt_state)
+                if self.opt_state is not None
+                else None
+            ),
         }
         while True:
             if self._jit_train_step is None:
@@ -873,6 +983,13 @@ class Trainer:
         schedule = self.scheduler
         schedule_count = self._schedule_count
         use_ls = self._use_loss_scale
+        # ZeRO-1 closure state: the per-leaf pad/shard plan and the
+        # shardings the constrained update runs under (all None when
+        # optimizer_sharding is off or the mesh has no multi-way data axis)
+        zero_plan = self._zero_plan
+        zero_param_shardings = self._zero_param_shardings
+        zero_state_shardings = self._zero_shardings
+        param_shardings = self._param_shardings
         # the optimizer chain is built without clip_by_global_norm — the step
         # clips the flat gradient vector itself whenever max_grad_norm is set
         clip_norm = self.max_grad_norm
@@ -1034,17 +1151,47 @@ class Trainer:
                 )
             )
 
-            updates, new_opt_state = optimizer.update(grads, opt_state, params)
-            if self._zero_shardings is not None:
+            if zero_plan is not None:
+                # ZeRO-1 update (the --optimizer_sharding zero1 hot path):
+                # pad grads and params into the per-leaf plan layout and
+                # CONSTRAIN them onto the data axis — GSPMD then lowers the
+                # gradient reduction as a reduce-scatter (each replica
+                # receives only its shard's sum, never the full gradient)
+                # and the weight update touches 1/N of the elements per
+                # chip against the 1/N-resident moments; the updates are
+                # sliced back to logical shapes and applied to the
+                # replicated params, which is the trailing all-gather of
+                # the ZeRO-1 pattern (arxiv 2004.13336).
+                grads_p = jax.lax.with_sharding_constraint(
+                    zero_pad_tree(grads, zero_plan), zero_param_shardings
+                )
+                params_p = jax.lax.with_sharding_constraint(
+                    zero_pad_tree(params, zero_plan), zero_param_shardings
+                )
+                updates_p, new_opt_state = optimizer.update(
+                    grads_p, opt_state, params_p
+                )
                 # keep the ZeRO layout stable across steps: without the
                 # constraint GSPMD may re-layout the donated state to match
                 # whatever the update fusion preferred
                 new_opt_state = jax.lax.with_sharding_constraint(
-                    new_opt_state, self._zero_shardings
+                    new_opt_state, zero_state_shardings
+                )
+                updates = zero_unpad_tree(updates_p, zero_plan, params)
+            else:
+                updates, new_opt_state = optimizer.update(
+                    grads, opt_state, params
                 )
             new_params = jax.tree_util.tree_map(
                 lambda p, u: (p + u).astype(p.dtype), params, updates
             )
+            if zero_plan is not None and param_shardings is not None:
+                # the forward consumes replicated params — pin the
+                # all-gathered result to the params' own (replicated or TP)
+                # layout so the donated buffers keep their shape
+                new_params = jax.lax.with_sharding_constraint(
+                    new_params, param_shardings
+                )
 
             # lr APPLIED this step: optax scale_by_schedule reads
             # schedule(count) pre-increment. Without loss scaling count ==
@@ -1599,6 +1746,7 @@ class Trainer:
         # path in particular), which dwarfs a step — a slow save must not be
         # misclassified as a hang and crash-looped. Barriers inside inherit
         # this budget (watchdog.arm nested-frame default).
+        extra = {"opt_sharding": self.effective_opt_sharding}
         with self._watched(f"checkpoint save {path_}", scale=8.0):
             if self.sharded_checkpoint:
                 from .checkpoint import save_state_dict_sharded
@@ -1609,6 +1757,7 @@ class Trainer:
                     opt_state=opt_state,
                     loss_scale=ls_state,
                     global_step=self.global_step,
+                    extra=extra,
                 )
                 return
             _save_ckpt(
@@ -1618,6 +1767,7 @@ class Trainer:
                 loss_scale=ls_state,
                 global_step=self.global_step,
                 is_primary=self.is_primary,
+                extra=extra,
             )
 
     def load_state_dict(self, path_):
@@ -1631,6 +1781,12 @@ class Trainer:
         )
         if global_step is None:
             return
+        if not self.drop_optimizer and live_opt is not None and opt_state is not None:
+            # mesh-shape / sharding-mode portability: crop/zero-fill each
+            # restored leaf onto the LIVE (possibly differently padded)
+            # zero1 layout before re-placement — a save at mesh N resumes
+            # at mesh M and across --optimizer_sharding modes
+            opt_state = reconcile_state_shapes(opt_state, live_opt)
         if live_ls is not None:
             mode_differs = bool(ls_state.dynamic) != bool(live_ls.dynamic)
             static_value_differs = (
@@ -1657,12 +1813,22 @@ class Trainer:
                     lambda x: put_single(x, self.mesh), opt_state
                 )
         else:
-            self.params = jax.tree_util.tree_map(
-                jax.device_put, params, self._param_shardings
-            )
+            # Restored host state goes through a jitted identity with
+            # explicit out_shardings, NOT a plain device_put: on the CPU
+            # runtime device_put zero-copies a host numpy buffer without
+            # keeping it alive, the train step then DONATES that buffer,
+            # and the next step reads freed memory (observed as heap
+            # corruption on every resume-then-train on the virtual
+            # multi-device mesh; msgpack-restored leaves are additionally
+            # read-only views into the checkpoint blob, which donation
+            # must never write into). The jit identity copies every leaf
+            # into runtime-owned buffers in one compiled program.
+            self.params = jax.jit(
+                lambda x: x, out_shardings=self._param_shardings
+            )(params)
             if not self.drop_optimizer and self.opt_state is not None:
                 shardings = jax.tree_util.tree_map(lambda x: x.sharding, self.opt_state)
-                self.opt_state = jax.tree_util.tree_map(
-                    jax.device_put, opt_state, shardings
-                )
+                self.opt_state = jax.jit(
+                    lambda x: x, out_shardings=shardings
+                )(opt_state)
         self.global_step = global_step

@@ -375,3 +375,61 @@ def test_bench_input_sequence_packing_off_skips_packed_pass(bench, capsys):
     parsed = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert "padding_waste_pct_packed" not in parsed
     assert "packing_efficiency" not in parsed
+
+
+def test_param_count_probe_reports_modeled_zero1_bytes(bench, capsys):
+    """ISSUE-8 satellite: ``bench.py --mode train --param_count_probe``
+    reports modeled replicated-vs-zero1 optimizer bytes per chip WITHOUT
+    running (or compiling) a step, at a mocked device count — the HBM
+    planning that must work before a TPU window opens. The acceptance
+    inequality (savings >= (N-1)/N of the sharded-leaf footprint) is
+    pinned on the probe's own numbers."""
+    import types
+
+    N = 8
+    args = types.SimpleNamespace(
+        model="bert-tiny", seq_len=128, optimizer="adam",
+        probe_devices=N, zero_min_size=0,
+    )
+    bench.param_count_probe(args)
+    parsed = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert parsed["mode"] == "param_count_probe"
+    assert parsed["devices"] == N
+    assert parsed["param_count"] > 0
+    rep = parsed["opt_bytes_per_chip_replicated"]
+    zero = parsed["opt_bytes_per_chip_zero1"]
+    sharded = parsed["opt_bytes_sharded_leaves"]
+    # adam: mu+nu, so the replicated state is ~2 f32 per param
+    assert rep >= 8 * parsed["param_count"]
+    # the acceptance inequality, with one shard-row of padding slack
+    assert rep - zero >= (N - 1) / N * sharded - 0.01 * sharded
+    assert parsed["zero1_savings_pct"] > 80
+
+    # a wider mocked pod shrinks the per-chip bytes further
+    args.probe_devices = 64
+    bench.param_count_probe(args)
+    wide = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert wide["opt_bytes_per_chip_zero1"] < zero
+    assert wide["opt_bytes_per_chip_replicated"] == rep
+
+
+def test_param_count_probe_adamod_carries_third_moment(bench, capsys):
+    """AdaMod adds exp_avg_lr: its modeled replicated footprint must be
+    ~3/2 of adam's on the same model."""
+    import types
+
+    def probe(opt):
+        args = types.SimpleNamespace(
+            model="bert-tiny", seq_len=128, optimizer=opt,
+            probe_devices=8, zero_min_size=0,
+        )
+        bench.param_count_probe(args)
+        return json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+    adam = probe("adam")
+    adamod = probe("adamod")
+    ratio = (
+        adamod["opt_bytes_per_chip_replicated"]
+        / adam["opt_bytes_per_chip_replicated"]
+    )
+    assert 1.3 < ratio < 1.7

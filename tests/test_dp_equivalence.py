@@ -73,7 +73,8 @@ def test_dp8_matches_single_device_with_batch_split(tmp_path):
 
 def test_dp8_zero_matches_single_device(tmp_path):
     """ZeRO-1 sharded optimizer on the mesh vs plain replicated single-device:
-    sharding the moments must not change the math."""
+    sharding the moments must not change the math (legacy shard_optimizer
+    boolean spelling — kept as the back-compat pin)."""
     dp, _ = _make_trainer(
         tmp_path, mesh_spec="data:8", dropout=0.0, n_epochs=2,
         batch_split=2, shard_optimizer=True, zero_min_size=0,
@@ -81,6 +82,56 @@ def test_dp8_zero_matches_single_device(tmp_path):
     single, _ = _make_trainer(tmp_path, mesh_spec="data:1",
                               dropout=0.0, n_epochs=2, batch_split=2)
     _assert_same_trajectory(_run(dp), _run(single))
+
+
+def test_zero1_single_chip_bit_identical_to_off(tmp_path):
+    """ISSUE-8 acceptance: ``--optimizer_sharding zero1`` on a 1-chip mesh
+    must produce a trajectory BIT-identical to ``off`` — with one device
+    there is nothing to shard, and zero1 must take the replicated code
+    path exactly (no padding, no constraints, no layout drift)."""
+    z, _ = _make_trainer(tmp_path, mesh_spec="data:1", dropout=0.0,
+                         n_epochs=2, batch_split=2,
+                         optimizer_sharding="zero1")
+    off, _ = _make_trainer(tmp_path, mesh_spec="data:1", dropout=0.0,
+                           n_epochs=2, batch_split=2,
+                           optimizer_sharding="off")
+    assert z.opt_sharding_mode == "zero1" and not z.zero_enabled()
+    losses_z, params_z = _run(z)
+    losses_o, params_o = _run(off)
+    assert len(losses_z) == len(losses_o) >= 4
+    assert losses_z == losses_o, "1-chip zero1 trajectory not bit-identical"
+    for x, y in zip(
+        jax.tree_util.tree_leaves(params_z), jax.tree_util.tree_leaves(params_o)
+    ):
+        np.testing.assert_array_equal(
+            x, y, err_msg="1-chip zero1 final params not bit-identical"
+        )
+
+
+def test_zero1_2way_matches_replicated(tmp_path):
+    """ISSUE-8 acceptance (2-way): zero1 over data:2 vs the replicated
+    layout on the same mesh — identical math up to deterministic-reduction
+    reordering. data:2 exercises the padding-free divisible dims; the
+    8-way variant below exercises the padded ones (e.g. the 5-label
+    classifier bias padded 5 -> 8)."""
+    z, _ = _make_trainer(tmp_path, mesh_spec="data:2", dropout=0.0,
+                         n_epochs=2, batch_split=2,
+                         optimizer_sharding="zero1", zero_min_size=0)
+    off, _ = _make_trainer(tmp_path, mesh_spec="data:2", dropout=0.0,
+                           n_epochs=2, batch_split=2)
+    _assert_same_trajectory(_run(z), _run(off))
+
+
+def test_zero1_8way_matches_replicated(tmp_path):
+    """ISSUE-8 acceptance (wide way): zero1 over data:8 vs replicated on
+    the same mesh, zero_min_size=0 so every leaf shards — including the
+    padding-aware ones whose dims do not divide by 8."""
+    z, _ = _make_trainer(tmp_path, mesh_spec="data:8", dropout=0.0,
+                         n_epochs=2, batch_split=2,
+                         optimizer_sharding="zero1", zero_min_size=0)
+    off, _ = _make_trainer(tmp_path, mesh_spec="data:8", dropout=0.0,
+                           n_epochs=2, batch_split=2)
+    _assert_same_trajectory(_run(z), _run(off))
 
 
 def test_dp8_matches_single_device_with_threefry_dropout(tmp_path):

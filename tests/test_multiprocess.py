@@ -527,3 +527,132 @@ def test_two_process_sharded_checkpoint(tmp_path):
         n = sum(len(pieces) for g in blob["shards"].values()
                 for pieces in g.values())
         assert n > 0, f"{f} owns no shards"
+
+
+ORACLE_WORKER = r"""
+import os, sys
+sys.path.insert(0, os.environ["REPO_ROOT"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+# join the world FIRST: any import that touches a jax device query would
+# otherwise pin the single-process CPU backend
+from ml_recipe_tpu.parallel import initialize_from_env
+
+initialize_from_env()
+
+import numpy as np
+
+from ml_recipe_tpu.data.bucketing import BucketedDataLoader
+from ml_recipe_tpu.data.collate import make_collate_fun
+from ml_recipe_tpu.data.datasets import DatasetItem
+from ml_recipe_tpu.data.loader import ShardedBatchSampler
+from ml_recipe_tpu.data.packing import PackedDataLoader
+from ml_recipe_tpu.losses import build_loss
+from ml_recipe_tpu.models import EncoderConfig, QAModel
+from ml_recipe_tpu.parallel import barrier, build_mesh, is_primary
+from ml_recipe_tpu.tokenizer import Tokenizer
+from ml_recipe_tpu.train import Trainer
+
+rank = jax.process_index()
+
+vocab = os.path.join(os.environ["WORK_DIR"], "vocab.txt")
+if is_primary():
+    with open(vocab + ".tmp", "w") as f:
+        f.write("\n".join(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+                          + [f"tok{i}" for i in range(45)]))
+    os.replace(vocab + ".tmp", vocab)
+barrier("vocab")
+tok = Tokenizer("bert", vocab)
+
+
+class VarLen:
+    def __init__(self, n, max_len):
+        self.n, self.L = n, max_len
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        rng = np.random.default_rng([13, int(i)])
+        n = int(rng.integers(10, self.L // 2 + 1))
+        body = rng.integers(5, len(tok), max(n - 3, 1)).tolist()
+        ids = [tok.cls_token_id, *body, tok.sep_token_id, tok.sep_token_id]
+        start = int(rng.integers(0, len(ids)))
+        return DatasetItem(
+            example_id=str(i), input_ids=ids, start_id=start,
+            end_id=min(start + 2, len(ids) - 1),
+            label_id=int(rng.integers(0, 5)),
+            start_position=start / self.L, end_position=(start + 2) / self.L,
+        )
+
+
+ds = VarLen(48, 48)
+collate = make_collate_fun(tok, max_seq_len=48)
+
+# loader-level lockstep: both ranks must compute the IDENTICAL epoch plan
+sampler = ShardedBatchSampler(len(ds), 8, process_index=rank,
+                              process_count=2, shuffle=True, drop_last=True,
+                              seed=0)
+bucketed = BucketedDataLoader(ds, sampler, collate, seq_grid=[16, 32, 48],
+                              token_budget=8 * 48, batch_multiple=2, n_jobs=2)
+bucketed.set_epoch(1)
+bucket_plan = [(b.seq, b.rows, b.real_rows,
+                int(np.asarray(b.inputs["input_ids"]).shape[0]))
+               for b in bucketed]
+packed = PackedDataLoader(ds, sampler, tok, max_seq_len=48, rows_per_batch=8,
+                          n_jobs=2)
+packed.set_epoch(1)
+pack_plan = [(b.rows, b.segments, b.seq,
+              int(np.asarray(b.inputs["input_ids"]).shape[0]))
+             for b in packed]
+assert all(local == 4 for _, _, _, local in pack_plan), pack_plan
+
+# end-to-end: a 2-process packed TRAIN must hold step shapes in lockstep
+# (this is exactly what used to force the single-process fallback)
+class TP:
+    loss = "ce"; smooth_alpha = 0.01; focal_alpha = 1; focal_gamma = 2
+    w_start = 1; w_end = 1; w_start_reg = 0.5; w_end_reg = 0.5; w_cls = 1
+    lr = 1e-3; weight_decay = 0.01; warmup_coef = 0.0
+    optimizer = "adam"; finetune = False
+
+cfg = EncoderConfig(vocab_size=len(tok), hidden_size=16, num_layers=1,
+                    num_heads=2, intermediate_size=32,
+                    max_position_embeddings=50, num_labels=5,
+                    hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+model = QAModel(cfg)
+params = model.init(jax.random.key(0),
+                    np.asarray(ds[0].input_ids, np.int32)[None, :])["params"]
+t = Trainer(model=model, params=params, loss=build_loss(TP()),
+            collate_fun=collate, trainer_params=TP(), train_dataset=ds,
+            mesh=build_mesh(), n_epochs=1, train_batch_size=8,
+            batch_split=2, n_jobs=2, warmup_coef=0.0, max_grad_norm=1.0,
+            seed=0, sequence_packing=True, optimizer_sharding="zero1",
+            zero_min_size=0)
+t.train()
+
+from ml_recipe_tpu.parallel.sharding import gather_to_host
+leaves = jax.tree_util.tree_leaves(gather_to_host(t.params))
+checksum = float(sum(np.asarray(l, np.float64).sum() for l in leaves))
+print(f"ORACLE_OK rank={rank} bucket={bucket_plan} pack={pack_plan} "
+      f"step={t.global_step} checksum={checksum:.6f}", flush=True)
+"""
+
+
+def test_two_process_length_oracle_lockstep(tmp_path):
+    """ISSUE-8 satellite: the multi-host length-oracle path — two real
+    processes derive the IDENTICAL bucket and pack plans (shapes, order,
+    global row/segment accounting) from the shared oracle, and a packed
+    2-process ZeRO-1 training run holds step shapes in lockstep end to
+    end, finishing with bit-identical replicas."""
+    script = tmp_path / "oracle_worker.py"
+    script.write_text(ORACLE_WORKER)
+
+    lines = []
+    for rank, (p, out) in enumerate(_run_world(script, tmp_path)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        ok = [l for l in out.splitlines() if l.startswith("ORACLE_OK")]
+        assert ok, out
+        lines.append(ok[0])
+    # identical plans + identical trained replicas on both ranks
+    assert lines[0].split("rank=0 ")[1] == lines[1].split("rank=1 ")[1], lines

@@ -302,15 +302,58 @@ def test_packed_loader_planned_steps_match_actual(tmp_path):
     assert planned < len(loader)
 
 
-def test_packed_loader_rejects_multiprocess(tmp_path):
+def test_packed_loader_multi_host_lockstep(tmp_path):
+    """ISSUE-8 satellite: multi-host packing — two process-ranked loaders
+    derive the IDENTICAL epoch pack plan from the shared length oracle
+    (same (rows, segments) per step, in the same order), their
+    concatenated row slices reproduce the single-process loader's batches
+    bit for bit (segment_mask included), and the LR-schedule plan is
+    host-invariant."""
+    tok = make_tokenizer(tmp_path)
+    ds = VarLenDataset(tok, 48, MAX_SEQ_LEN)
+
+    def loader(pi, pc):
+        sampler = ShardedBatchSampler(
+            len(ds), 8, process_index=pi, process_count=pc,
+            shuffle=True, drop_last=True, seed=0,
+        )
+        ldr = PackedDataLoader(
+            ds, sampler, tok, max_seq_len=MAX_SEQ_LEN, rows_per_batch=8,
+            n_jobs=2,
+        )
+        ldr.set_epoch(1)
+        return ldr
+
+    single, p0, p1 = loader(0, 1), loader(0, 2), loader(1, 2)
+    bs, b0, b1 = list(single), list(p0), list(p1)
+    assert len(bs) == len(b0) == len(b1) >= 1
+    for s, a, b in zip(bs, b0, b1):
+        assert (s.rows, s.segments, s.seq) == (a.rows, a.segments, a.seq)
+        assert (a.rows, a.segments, a.seq) == (b.rows, b.segments, b.seq)
+        assert a.inputs["input_ids"].shape[0] == s.rows // 2
+        for key in ("input_ids", "segment_ids", "position_ids"):
+            merged = np.concatenate([a.inputs[key], b.inputs[key]])
+            np.testing.assert_array_equal(merged, s.inputs[key])
+        merged_mask = np.concatenate(
+            [a.labels["segment_mask"], b.labels["segment_mask"]]
+        )
+        np.testing.assert_array_equal(merged_mask, s.labels["segment_mask"])
+    assert (
+        p0.planned_epoch_steps(1)
+        == p1.planned_epoch_steps(1)
+        == single.planned_epoch_steps(1)
+    )
+
+
+def test_packed_loader_multi_host_requires_divisible_rows(tmp_path):
     tok = make_tokenizer(tmp_path)
     sampler = ShardedBatchSampler(
         16, 8, process_index=0, process_count=2, seed=0
     )
-    with pytest.raises(ValueError, match="single-process"):
+    with pytest.raises(ValueError, match="divide over"):
         PackedDataLoader(
             VarLenDataset(tok, 16, MAX_SEQ_LEN), sampler, tok,
-            max_seq_len=MAX_SEQ_LEN, rows_per_batch=4,
+            max_seq_len=MAX_SEQ_LEN, rows_per_batch=5,
         )
 
 
@@ -530,3 +573,53 @@ def test_prefetch_auto_picks_and_logs(tmp_path, caplog):
         trainer.train()
     assert trainer._prefetch_choice in (1, 2)
     assert "device_prefetch auto" in caplog.text
+
+
+def test_oracle_read_is_per_epoch_deterministic_but_epoch_fresh(tmp_path):
+    """The shared length oracle pins a stochastic-chunk dataset's draw to
+    (epoch, index): repeats within an epoch are bit-identical (the length
+    pass and the collate pass must see the SAME item on every host), while
+    a new epoch draws fresh chunks — multi-host runs keep the cross-epoch
+    chunk-resampling augmentation the single-host live-rng path has."""
+    import numpy as np
+
+    from ml_recipe_tpu.data.packing import oracle_epoch_lengths, oracle_read
+
+    class StochasticDS:
+        def __init__(self):
+            self.rng = np.random.default_rng(123)
+
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            n = int(self.rng.integers(5, 40))
+            return DatasetItem(
+                example_id=str(i), input_ids=list(range(n)), start_id=0,
+                end_id=1, label_id=0, start_position=0.0, end_position=0.1,
+            )
+
+    ds = StochasticDS()
+    train_state = ds.rng.bit_generator.state  # snapshot the live stream
+    a = oracle_read(ds, 3, epoch=1)
+    b = oracle_read(ds, 3, epoch=1)
+    c = oracle_read(ds, 3, epoch=2)
+    assert a.input_ids == b.input_ids            # repeatable within epoch
+    # fresh draws next epoch: over 8 indices the all-collide probability
+    # is negligible (per-index lengths are drawn from 35 values)
+    e1 = [len(oracle_read(ds, i, epoch=1).input_ids) for i in range(8)]
+    e2 = [len(oracle_read(ds, i, epoch=2).input_ids) for i in range(8)]
+    assert e1 != e2
+    assert len(c.input_ids) == e2[3]
+    # the training draw stream was never perturbed by oracle reads
+    assert ds.rng.bit_generator.state == train_state
+
+    cache = {}
+    l1 = oracle_epoch_lengths(ds, [3, 3, 5], cache=cache, n_jobs=2,
+                              read_retries=0, epoch=1)
+    l2 = oracle_epoch_lengths(ds, [3, 5], cache=cache, n_jobs=2,
+                              read_retries=0, epoch=2)
+    assert l1[0] == l1[1] == len(a.input_ids)
+    assert l2[0] == len(c.input_ids)
+    # per-epoch cache keys: both epochs' lengths live side by side
+    assert (1, 3) in cache and (2, 3) in cache

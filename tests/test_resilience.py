@@ -918,3 +918,173 @@ def test_no_bare_except_in_package():
         ["bash", script], capture_output=True, text=True, cwd=REPO_ROOT
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 checkpoint portability (ISSUE 8): manifest shard layout + resume
+# across a mesh-shape change
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_records_shard_layout_and_peek(tmp_path):
+    """The sharded manifest records each leaf's shard count (and the
+    headline ``shards: N``), readable WITHOUT loading tensors; a restore
+    into a mismatched optimizer layout fails loudly with expected-vs-found
+    instead of a shape error mid-restore."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from flax import serialization
+    from ml_recipe_tpu.parallel import build_mesh
+    from ml_recipe_tpu.train.checkpoint import (
+        CheckpointLayoutError,
+        load_state_dict_sharded,
+        peek_checkpoint_layout,
+        save_state_dict_sharded,
+    )
+
+    mesh = build_mesh("data:8")
+    params = {"w": np.arange(16, dtype=np.float32)}
+    opt = {
+        "mu": {
+            "w": jax.device_put(
+                np.ones(16, np.float32), NamedSharding(mesh, P("data"))
+            )
+        },
+        "count": np.int32(3),
+    }
+    ckpt = tmp_path / "zero.ch"
+    save_state_dict_sharded(
+        str(ckpt), params=params, opt_state=opt, global_step=7,
+        extra={"opt_sharding": "zero1"},
+    )
+
+    manifest = serialization.msgpack_restore(
+        (ckpt / "manifest.msgpack").read_bytes()
+    )
+    assert manifest["shards"] == 8
+    assert manifest["groups"]["optimizer"]["mu/w"]["shards"] == 8
+    assert manifest["groups"]["model"]["w"]["shards"] == 1
+
+    layout = peek_checkpoint_layout(ckpt)
+    assert layout == {
+        "format": "sharded",
+        "global_step": 7,
+        "process_count": 1,
+        "shards": 8,
+        "opt_sharding": "zero1",
+        "groups": {"model": 1, "optimizer": 2},
+    }
+    assert peek_checkpoint_layout(tmp_path / "absent.ch") is None
+
+    # loud expected-vs-found on a mismatched optimizer layout (a different
+    # chain), BEFORE any tensor restore
+    bad_target = {"nu": {"w": np.zeros(16, np.float32)}, "count": np.int32(0)}
+    with pytest.raises(CheckpointLayoutError) as err:
+        load_state_dict_sharded(
+            str(ckpt), params=params, opt_state=bad_target
+        )
+    msg = str(err.value)
+    assert "mu/w" in msg and "nu/w" in msg and "shards=8" in msg
+
+    # equal-rank shape changes are NOT a layout error — that is what a
+    # ZeRO-1 mesh-shape change looks like (the trainer crops/zero-fills)
+    wider = {"mu": {"w": np.zeros(24, np.float32)}, "count": np.int32(0)}
+    restored = load_state_dict_sharded(
+        str(ckpt), params=params, opt_state=wider
+    )
+    assert restored[3] == 7
+
+    # rank changes ARE a layout error, not a cryptic numpy failure
+    bad_rank = {"mu": {"w": np.zeros((4, 4), np.float32)}, "count": np.int32(0)}
+    with pytest.raises(CheckpointLayoutError, match="rank mismatch"):
+        load_state_dict_sharded(str(ckpt), params=params, opt_state=bad_rank)
+
+
+_ZERO_RESHAPE_TRAIN = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, sys.argv[4])                    # tests/ (conftest)
+    sys.path.insert(0, os.path.dirname(sys.argv[4]))   # repo root
+    import conftest  # 8-device CPU mesh + autotune cache isolation
+    import pathlib
+    import numpy as np
+    import jax
+
+    from test_trainer import _make_trainer
+    from ml_recipe_tpu.parallel.sharding import gather_to_host
+
+    work = pathlib.Path(sys.argv[1]); mesh_spec = sys.argv[2]
+    mode = sys.argv[3]
+    (work / mesh_spec.replace(":", "_")).mkdir(exist_ok=True)
+    kw = {}
+    if mode != "off":
+        kw = dict(optimizer_sharding=mode, zero_min_size=0)
+    t, _ = _make_trainer(
+        work / mesh_spec.replace(":", "_"), mesh_spec=mesh_spec,
+        dropout=0.0, n_epochs=1, batch_split=2, sharded_checkpoint=True,
+        **kw,
+    )
+    ckpt = work / "zero_reshape.ch"
+    if ckpt.exists():
+        t.load_state_dict(ckpt)
+        resumed_from = t.global_step
+        assert resumed_from > 0, "resume did not restore the step"
+        # params must equal what the saver trained, bit for bit on host
+        want = np.load(work / "params_checksum.npy")
+        leaves = jax.tree_util.tree_leaves(gather_to_host(t.params))
+        got = np.float64(sum(np.asarray(l, np.float64).sum() for l in leaves))
+        assert abs(got - want) < 1e-6, (got, want)
+        # optimizer moments survive too (logical overlap; padding differs
+        # with the mesh) — then training CONTINUES on the new mesh
+        t.n_epochs = 1
+        t.train()
+        assert t.global_step > resumed_from
+        print(f"RESUMED_OK mesh={mesh_spec} mode={mode} "
+              f"step={t.global_step}", flush=True)
+    else:
+        t.train()
+        t.save_state_dict(ckpt)
+        leaves = jax.tree_util.tree_leaves(gather_to_host(t.params))
+        total = np.float64(sum(np.asarray(l, np.float64).sum() for l in leaves))
+        np.save(work / "params_checksum.npy", total)
+        print(f"SAVED_OK step={t.global_step}", flush=True)
+    """
+)
+
+
+@pytest.mark.slow
+def test_zero1_checkpoint_survives_mesh_reshape(tmp_path):
+    """ISSUE-8 acceptance: a checkpoint saved under zero1 at mesh N=4
+    restores at mesh M=2 (and under --optimizer_sharding off at N=8) with
+    crc32 manifest verification passing, and training continues. Each
+    phase runs in its own process — the same process-per-topology shape a
+    real resize takes (and XLA CPU corrupts its heap when a second mesh
+    trains after a cross-mesh load in one process)."""
+    script = tmp_path / "phase.py"
+    script.write_text(_ZERO_RESHAPE_TRAIN)
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+
+    def phase(mesh_spec, mode):
+        proc = subprocess.run(
+            [sys.executable, str(script), str(tmp_path), mesh_spec, mode,
+             tests_dir],
+            capture_output=True, text=True, timeout=900,
+            cwd=tests_dir,
+        )
+        assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-4000:]
+        return proc.stdout
+
+    out = phase("data:4", "zero1")
+    assert "SAVED_OK" in out
+
+    from ml_recipe_tpu.train.checkpoint import peek_checkpoint_layout
+
+    layout = peek_checkpoint_layout(tmp_path / "zero_reshape.ch")
+    assert layout["shards"] == 4 and layout["opt_sharding"] == "zero1"
+
+    # shrink: N=4 -> M=2, still zero1
+    assert "RESUMED_OK mesh=data:2 mode=zero1" in phase("data:2", "zero1")
+    # and back to a replicated layout on a wider mesh
+    assert "RESUMED_OK mesh=data:8 mode=off" in phase("data:8", "off")

@@ -1068,3 +1068,170 @@ def test_log_every_throttles_writer_updates(tmp_path):
     # writes at step 2 ((2+1) % 3 == 0) and the final write at step 3
     write_steps = sorted({s for _, s in writes})
     assert write_steps == [2, 3]
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 sharded optimizer state (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+
+def test_zero1_opt_state_bytes_reduction(tmp_path):
+    """ISSUE-8 acceptance: on an N-device data mesh, zero1 reduces the
+    MEASURED per-chip optimizer-state bytes by at least (N-1)/N of the
+    replicated footprint of the leaves the plan shards — asserted against
+    the same modeled arithmetic the HBM-planning probe reports."""
+    import jax
+
+    from ml_recipe_tpu.parallel.sharding import (
+        opt_state_bytes_per_chip,
+        zero1_state_bytes,
+    )
+
+    N = 8
+    (tmp_path / "z").mkdir()
+    z, _ = _make_trainer(tmp_path / "z", mesh_spec="data:8", dropout=0.0,
+                         optimizer_sharding="zero1", zero_min_size=0)
+    (tmp_path / "o").mkdir()
+    o, _ = _make_trainer(tmp_path / "o", mesh_spec="data:8", dropout=0.0)
+
+    measured_zero = opt_state_bytes_per_chip(z._split_ls()[0])
+    measured_off = opt_state_bytes_per_chip(o._split_ls()[0])
+
+    state_shapes = jax.eval_shape(o.optimizer.init, o.params)
+    model = zero1_state_bytes(state_shapes, data_size=N, min_size=0)
+    # measured == modeled, both directions (the probe's numbers are real)
+    assert measured_off == model["replicated_bytes"]
+    assert measured_zero == model["zero1_bytes"]
+    # the acceptance inequality: savings >= (N-1)/N * sharded-leaf bytes,
+    # up to the EXACT padding overhead (ceil shards of the padded leaves
+    # hold slightly more than bytes/N) — which must itself be negligible
+    nonsharded = model["replicated_bytes"] - model["sharded_bytes"]
+    pad_overhead = (
+        model["zero1_bytes"] - nonsharded - model["sharded_bytes"] / N
+    )
+    assert 0 <= pad_overhead < 0.01 * model["sharded_bytes"]
+    assert (
+        measured_off - measured_zero
+        >= (N - 1) / N * model["sharded_bytes"] - pad_overhead - 1e-6
+    )
+
+
+def test_zero1_modeled_bytes_mocked_device_count():
+    """The modeled arithmetic at an arbitrary (mocked) device count — no
+    mesh, no devices: a v5e-64 plan computable on a laptop. Exact ceil
+    arithmetic pinned on a padded leaf: (50,) f32 at N=8 pads to 56 and
+    costs 7 floats per chip."""
+    import jax
+
+    from ml_recipe_tpu.parallel.sharding import zero1_state_bytes
+
+    state = {
+        "mu": {
+            "kernel": jax.ShapeDtypeStruct((64, 32), jnp.float32),
+            "bias": jax.ShapeDtypeStruct((50,), jnp.float32),
+        },
+        "count": jax.ShapeDtypeStruct((), jnp.int32),
+    }
+    out = zero1_state_bytes(state, data_size=8, min_size=0)
+    assert out["replicated_bytes"] == 64 * 32 * 4 + 50 * 4 + 4
+    # kernel shards evenly (64/8 rows), bias pads 50 -> 56 (7 per chip),
+    # the scalar count stays replicated
+    assert out["zero1_bytes"] == (64 * 32 // 8) * 4 + 7 * 4 + 4
+    assert out["sharded_bytes"] == 64 * 32 * 4 + 50 * 4
+
+    # a genuinely mocked pod width: N=64 on the same shapes
+    wide = zero1_state_bytes(state, data_size=64, min_size=0)
+    assert wide["zero1_bytes"] < out["zero1_bytes"]
+    # min_size floor: everything below stays replicated
+    floored = zero1_state_bytes(state, data_size=8, min_size=10 ** 9)
+    assert floored["zero1_bytes"] == floored["replicated_bytes"]
+
+
+def test_preflight_report_carries_opt_sharding_fields(tmp_path):
+    """The HBM pre-flight must SEE the zero1 state: its report names the
+    layout and the measured per-chip optimizer bytes, so a raised
+    batch_split decision is auditable against the memory that actually
+    exists."""
+    from ml_recipe_tpu.parallel.sharding import opt_state_bytes_per_chip
+
+    trainer, _ = _make_trainer(tmp_path, mesh_spec="data:8", batch_split=1,
+                               optimizer_sharding="zero1", zero_min_size=0)
+    report = trainer.preflight_train_step(
+        None, None, compile_fn=_fake_compile_fn([]), limit_bytes=10_000,
+    )
+    assert report["opt_sharding"] == "zero1"
+    assert report["opt_state_bytes_per_chip"] == opt_state_bytes_per_chip(
+        trainer._split_ls()[0]
+    )
+    (tmp_path / "off").mkdir()
+    t_off, _ = _make_trainer(tmp_path / "off", batch_split=1)
+    report_off = t_off.preflight_train_step(
+        None, None, compile_fn=_fake_compile_fn([]), limit_bytes=10_000,
+    )
+    assert report_off["opt_sharding"] == "off"
+    assert (
+        report_off["opt_state_bytes_per_chip"]
+        > report["opt_state_bytes_per_chip"]
+    )
+
+
+def test_zero1_bad_mode_fails_at_build_time(tmp_path):
+    with pytest.raises(ValueError, match="optimizer_sharding"):
+        _make_trainer(tmp_path, optimizer_sharding="zero3")
+
+
+class ZeroFinetuneTP(TP):
+    finetune = True
+    finetune_position = True
+    finetune_class = True
+
+
+def test_masks_share_one_path_walk_and_compose_with_zero1(tmp_path):
+    """ISSUE-8 small fix: no_decay_mask and trainable_mask derive from the
+    SAME path walk (param_path_mask), so they agree structurally on every
+    leaf — including leaves neither existed for when the masks were two
+    independent walks — and a frozen-encoder mask composes with zero1
+    sharded state: training updates only the fine-tuned heads, bit-exact
+    freezing for the rest."""
+    import jax
+
+    from ml_recipe_tpu.train.optim import (
+        no_decay_mask,
+        param_path_mask,
+        trainable_mask,
+    )
+
+    trainer, _ = _make_trainer(
+        tmp_path, mesh_spec="data:8", dropout=0.0, tp_cls=ZeroFinetuneTP,
+        optimizer_sharding="zero1", zero_min_size=0,
+    )
+    decay = no_decay_mask(trainer.params)
+    tmask = trainable_mask(trainer.params, ZeroFinetuneTP())
+    # one walk, one structure: a new leaf cannot land in one mask but not
+    # the other
+    assert jax.tree_util.tree_structure(decay) == jax.tree_util.tree_structure(
+        tmask
+    )
+    # the shared walk normalizes paths identically for both predicates
+    probe = {"new_module": {"bias": np.zeros(4), "kernel": np.zeros((4, 4))}}
+    assert param_path_mask(probe, lambda names: names[-1] == "bias") == {
+        "new_module": {"bias": True, "kernel": False}
+    }
+
+    before = _param_snapshot(trainer.params)
+    trainer.train()
+    after = _param_snapshot(
+        jax.tree_util.tree_map(lambda x: np.asarray(x), trainer.params)
+    )
+    flat_before = jax.tree_util.tree_flatten_with_path(before)[0]
+    flat_after = jax.tree_util.tree_leaves(after)
+    flat_mask = jax.tree_util.tree_leaves(tmask)
+    changed_any = False
+    for (path, x), y, trainable in zip(flat_before, flat_after, flat_mask):
+        if trainable:
+            changed_any = changed_any or not np.array_equal(x, y)
+        else:
+            np.testing.assert_array_equal(
+                x, y, err_msg=f"frozen leaf {path} changed under zero1"
+            )
+    assert changed_any, "no fine-tuned leaf moved"
