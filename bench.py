@@ -556,6 +556,7 @@ def bench_infer(args) -> None:
         predictor(make_dataset(indexes[:2]))
 
         window_rates = []
+        window_elapsed = []
         for _ in range(max(1, args.window)):
             predictor.scores.clear()
             predictor.candidates.clear()
@@ -565,6 +566,17 @@ def bench_infer(args) -> None:
             elapsed = time.perf_counter() - t0
             chunks = sum(len(d[-1]) for d in predictor.dump)
             window_rates.append(chunks / elapsed)
+            window_elapsed.append(elapsed)
+
+        # observability twins (train-mode JSON parity): pass-time
+        # percentiles + the slow-step detector over the pass series
+        from ml_recipe_tpu.metrics.anomaly import SlowStepDetector
+
+        detector = SlowStepDetector(
+            factor=3.0, window=max(2, len(window_elapsed)), warmup=0,
+            min_steps=2)
+        for i, s in enumerate(window_elapsed):
+            detector.update(i, s, {"pass": s})
         # every document's chunks flowed through the loop (candidate VALIDITY
         # is score-dependent and not guaranteed under random-init params)
         seen_docs = {it.item_id for d in predictor.dump for it in d[-1]}
@@ -607,6 +619,11 @@ def bench_infer(args) -> None:
                     "chunks": chunks,
                     "docs": int(len(indexes)),
                     "chunks_per_sec_windows": [round(r, 1) for r in window_rates],
+                    "pass_time_s_p50": round(
+                        float(np.percentile(window_elapsed, 50)), 3),
+                    "pass_time_s_p95": round(
+                        float(np.percentile(window_elapsed, 95)), 3),
+                    "slow_pass_anomalies": detector.anomalies,
                     "batch_size": args.global_batch,
                     "fetch_every": args.fetch_every,
                     "n_chips": n_chips,
@@ -1287,6 +1304,17 @@ def main() -> None:
             float(values["loss"])  # host fetch = window sync
             window_step_s.append((time.perf_counter() - t0) / size)
 
+    # observability twins of the --metrics_port surface: step-time
+    # percentiles over the measured windows + the slow-step detector run
+    # over the same series (a thermal-throttled / noisy-neighbor window
+    # shows up as a nonzero anomaly count in the JSON line)
+    from ml_recipe_tpu.metrics.anomaly import SlowStepDetector
+
+    detector = SlowStepDetector(
+        factor=3.0, window=max(2, len(window_step_s)), warmup=0, min_steps=2)
+    for i, s in enumerate(window_step_s):
+        detector.update(i, s, {"device": s})
+
     med = float(np.median(window_step_s))
     step_time_ms = med * 1000.0
     examples_per_sec = args.global_batch / med
@@ -1326,6 +1354,15 @@ def main() -> None:
                 "step_time_ms_windows": [
                     round(s * 1000.0, 1) for s in window_step_s
                 ],
+                # step-time breakdown percentiles + anomaly count (this
+                # loop is device-bound by construction: the batch is
+                # pre-placed, so data-wait/host are zero here — the full
+                # three-way breakdown lives on the --metrics_port surface)
+                "step_time_ms_p50": round(
+                    float(np.percentile(window_step_s, 50)) * 1e3, 1),
+                "step_time_ms_p95": round(
+                    float(np.percentile(window_step_s, 95)) * 1e3, 1),
+                "slow_step_anomalies": detector.anomalies,
                 "global_batch": args.global_batch,
                 # pre-flight may have raised this above --batch_split
                 "batch_split": trainer.batch_split,

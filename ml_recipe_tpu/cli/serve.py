@@ -41,6 +41,18 @@ def main(params, model_params) -> int:
         enabled=params.autotune, cache_dir=params.autotune_cache
     )
 
+    # --trace_spans: structured request-lifecycle spans (admission ->
+    # queue -> flush -> device -> span_reduce -> respond, keyed by request
+    # id) as Chrome trace-event JSON, written out when the drain completes
+    tracer = None
+    if getattr(params, "trace_spans", None):
+        from ..metrics import trace as trace_mod
+
+        tracer = trace_mod.install(trace_mod.TraceWriter(
+            str(Path(params.trace_spans) / f"serve_trace_{os.getpid()}.json"),
+            process_name="serve",
+        ))
+
     model, model_state, tokenizer = init_model(
         model_params, checkpoint=params.checkpoint,
         quantize=getattr(params, "quantize", "off"),
@@ -88,6 +100,11 @@ def main(params, model_params) -> int:
         server.wait()
     finally:
         server.shutdown()
+        if tracer is not None:
+            from ..metrics import trace as trace_mod
+
+            trace_mod.install(None)
+            tracer.close()
     return 0
 
 

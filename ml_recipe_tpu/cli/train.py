@@ -112,6 +112,65 @@ def _run_worker(params, model_params, watchdog) -> None:
     rng_pool = set_seed(params.seed)
     data_rng = rng_pool.host_rng("chunk_sampling") if rng_pool else None
 
+    # Observability plane (all off by default): --trace_spans installs the
+    # process-global span tracer (trainer + checkpoint call sites emit
+    # through it), --metrics_port builds the training telemetry registry
+    # whose exporter starts once the Trainer exists (its health document
+    # reads live trainer state). Tracer install and the teardown of both
+    # bracket EVERYTHING below — a startup failure (model init, dataset
+    # build, a corrupt --last restore) must uninstall the process-global
+    # tracer and close the exporter port, not leak the instrumented path
+    # into later in-process runs.
+    tracer = None
+    if getattr(params, "trace_spans", None):
+        from ..metrics import trace as trace_mod
+
+        tracer = trace_mod.install(trace_mod.TraceWriter(
+            os.path.join(
+                str(params.trace_spans),
+                f"train_trace_p{jax.process_index()}.json",
+            ),
+            process_name="train",
+        ))
+
+    state = {"exporter": None}
+    try:
+        _run_instrumented(
+            params, model_params, watchdog, local_logger, mesh, data_rng,
+            state,
+        )
+    finally:
+        if state["exporter"] is not None:
+            state["exporter"].close()
+        if tracer is not None:
+            from ..metrics import trace as trace_mod
+
+            trace_mod.install(None)
+            tracer.close()  # flush the span file even on a non-clean exit
+
+
+def _run_instrumented(params, model_params, watchdog, local_logger, mesh,
+                      data_rng, state) -> None:
+    import jax
+
+    exp_dir = params.dump_dir / params.experiment_name
+    telemetry = None
+    if getattr(params, "metrics_port", None) is not None:
+        from ..resilience.supervisor import STATE_FILENAME
+        from ..train.telemetry import TrainTelemetry
+
+        telemetry = TrainTelemetry(
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            anomaly_factor=getattr(params, "anomaly_factor", 3.0),
+            anomaly_window=getattr(params, "anomaly_window", 64),
+            watchdog=watchdog,
+            # the supervisor (parent process) keeps this sidecar current;
+            # reading it cross-process is what puts restart counts on the
+            # child's /metrics without any coordination channel
+            supervisor_state_path=os.path.join(str(exp_dir), STATE_FILENAME),
+        )
+
     model, model_state, tokenizer = init_model(
         model_params, bpe_dropout=params.bpe_dropout,
         rng_seed=params.seed if params.seed is not None else 0,
@@ -169,10 +228,36 @@ def _run_worker(params, model_params, watchdog) -> None:
         pack_max_segments=getattr(params, "pack_max_segments", 8),
         device_prefetch=getattr(params, "device_prefetch", 0),
         log_every=getattr(params, "log_every", 10),
+        telemetry=telemetry,
     )
 
     if params.last is not None:
         trainer.load_state_dict(params.last)
+
+    if telemetry is not None:
+        from ..metrics.exporter import MetricsExporter
+
+        # multi-host: each process exports its own plane one port up from
+        # the base (port 0 = ephemeral stays ephemeral everywhere)
+        base_port = int(params.metrics_port)
+        port = base_port + jax.process_index() if base_port else 0
+
+        def health():
+            heartbeat = (
+                watchdog.heartbeat_age() if watchdog is not None else None
+            )
+            return {
+                "status": "ok",
+                "global_step": trainer.global_step,
+                "process_index": jax.process_index(),
+                "watchdog_heartbeat_age_s": heartbeat,
+            }
+
+        # the caller's finally closes it, whatever unwinds from here on
+        state["exporter"] = MetricsExporter(
+            telemetry.registry, port=port, health_fn=health,
+        ).start()
+        state["exporter"].add_pre_render(telemetry.refresh)
 
     def save_last(*args, **kwargs):
         trainer.save_state_dict(params.dump_dir / params.experiment_name / "last.ch")

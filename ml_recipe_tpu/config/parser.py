@@ -564,6 +564,37 @@ def get_trainer_parser() -> ConfigArgumentParser:
                              "(see resilience/faults.py for the grammar; "
                              "also via $MLRT_FAULTS).")
 
+    # Observability plane (metrics/ + train/telemetry.py): everything off
+    # by default — the off path is pinned bit-identical.
+    parser.add_argument("--metrics_port", type=cast2(int), default=None,
+                        help="Serve the training-plane Prometheus registry "
+                             "at http://0.0.0.0:<port>/metrics (+ /healthz) "
+                             "from a daemon thread: per-step wall-time "
+                             "breakdown (data wait / host / device), "
+                             "tokens/sec, padding waste, checkpoint "
+                             "durations, watchdog heartbeat age, supervisor "
+                             "restart counts. 0 binds an ephemeral port "
+                             "(logged); None (default) disables. Multi-host "
+                             "runs add the process index to the port so "
+                             "each host exports its own plane.")
+    parser.add_argument("--trace_spans", type=cast2(str), default=None,
+                        help="Write structured host trace spans (loader -> "
+                             "place/H2D -> step -> checkpoint) as Chrome "
+                             "trace-event JSON into this directory — load "
+                             "in Perfetto. Composes with --trace: the "
+                             "xplane window boundaries are marked in the "
+                             "span stream. None (default) disables.")
+    parser.add_argument("--anomaly_factor", type=float, default=3.0,
+                        help="Slow-step detector (active with "
+                             "--metrics_port): a step slower than this "
+                             "factor times the rolling median step time "
+                             "logs one structured WARNING with the "
+                             "breakdown attribution and increments "
+                             "train_slow_steps_total.")
+    parser.add_argument("--anomaly_window", type=int, default=64,
+                        help="Slow-step detector: rolling window size "
+                             "(steps) for the median+MAD baseline.")
+
     parser.add_argument("--best_metric", choices=["map"], type=str, default="map",
                         help="Best metric name.")
     parser.add_argument("--best_order", choices=[">", "<"], type=str, default=">",
@@ -753,6 +784,14 @@ def get_serve_parser() -> ConfigArgumentParser:
                         help="Write {host, port, pid} JSON here once the "
                              "listener is up (supervisor / test "
                              "orchestration hook).")
+
+    parser.add_argument("--trace_spans", type=cast2(str), default=None,
+                        help="Write structured serving trace spans "
+                             "(admission -> queue -> flush -> device -> "
+                             "span_reduce -> respond, keyed by request id) "
+                             "as Chrome trace-event JSON into this "
+                             "directory — load in Perfetto. The file is "
+                             "flushed on drain. None (default) disables.")
 
     return parser
 

@@ -39,6 +39,7 @@ import threading
 import traceback
 from typing import Any, Callable, Iterable, Iterator
 
+from ..metrics import trace as trace_mod
 from ..resilience.faults import fire as _fault
 from .loader import DataLoaderWorkerError
 
@@ -86,13 +87,17 @@ class DevicePrefetcher:
                 if self._stop.is_set():
                     return
                 _fault("loader.prefetch")
-                payload = (self._place(item),)
-                while not self._stop.is_set():
-                    try:
-                        self._queue.put(payload, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
+                # span covers placement AND the park on a full queue, so a
+                # Perfetto view of the prefetch track shows backpressure
+                # (device ahead of host) that the consumer-side spans can't
+                with trace_mod.span("prefetch_stage", cat="train"):
+                    payload = (self._place(item),)
+                    while not self._stop.is_set():
+                        try:
+                            self._queue.put(payload, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
                 if self._stop.is_set():
                     return
         except BaseException as exc:  # noqa: BLE001 - re-raised on consumer

@@ -1,4 +1,5 @@
 from .meters import AverageMeter, APMeter, MAPMeter, average_precision, accuracy_score
+from .registry import DEFAULT_BUCKETS, Counter, Gauge, Histogram, Info, Registry
 
 __all__ = [
     "AverageMeter",
@@ -6,4 +7,10 @@ __all__ = [
     "MAPMeter",
     "average_precision",
     "accuracy_score",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Info",
+    "Registry",
+    "DEFAULT_BUCKETS",
 ]

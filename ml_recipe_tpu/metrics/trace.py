@@ -1,0 +1,271 @@
+"""Structured trace spans: first-party Chrome trace-event JSON.
+
+A :class:`TraceWriter` collects complete-duration events (``"ph": "X"``) and
+instants (``"ph": "i"``) and serializes them in the Chrome trace-event JSON
+format — load the file in Perfetto (https://ui.perfetto.dev) or
+``chrome://tracing``. Spans cover the host side of both planes:
+
+- training: ``loader`` (data wait) → ``place`` (collate + micro split +
+  H2D) → ``step`` (dispatch + device) → ``checkpoint``;
+- serving: ``admission`` → ``queue`` → ``flush`` → ``device`` →
+  ``span_reduce`` → ``respond``, keyed by request id in ``args``.
+
+The module-level ``install``/``current``/``span`` trio mirrors the
+watchdog's process-global pattern so deep call sites (engine batcher
+thread, prefetch worker) need no handle threading; with no tracer
+installed every hook is a no-op costing one global load and a None check —
+the off path stays untouched.
+
+Timestamps come from ``time.perf_counter()`` against a per-writer origin —
+Chrome trace ``ts`` values are relative microseconds, so a monotonic
+interval clock is the correct source (and the wall clock is not).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+# bound memory on multi-day runs: the newest events win (the tail of a run
+# is what an operator debugging it actually loads)
+_MAX_EVENTS = 200_000
+
+
+class TraceWriter:
+    """Thread-safe Chrome trace-event collector.
+
+    ``complete(name, t0, t1)`` records a span from explicit
+    ``perf_counter`` readings (for call sites that timed the interval
+    themselves, e.g. queue wait reconstructed from an enqueue stamp);
+    ``span(name)`` is the context-manager spelling. ``tid`` defaults to the
+    calling thread so Perfetto lays concurrent planes out on separate
+    tracks.
+    """
+
+    def __init__(self, path: str, *, process_name: str = "ml_recipe_tpu"):
+        self.path = os.fspath(path)
+        self.origin = time.perf_counter()
+        self._events: List[Dict[str, Any]] = []
+        self._dropped = 0
+        self._lock = threading.Lock()
+        self._pid = os.getpid()
+        self._meta = process_name
+
+    # -- clock -----------------------------------------------------------------
+
+    def now(self) -> float:
+        """Current ``perf_counter`` reading (callers stamp intervals with
+        this so explicit ``complete`` calls share the writer's clock)."""
+        return time.perf_counter()
+
+    def _us(self, t: float) -> float:
+        return (t - self.origin) * 1e6
+
+    # -- event emission --------------------------------------------------------
+
+    def _append(self, event: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(self._events) >= _MAX_EVENTS:
+                # drop the OLDEST half once, keeping the recent window
+                self._dropped += len(self._events) // 2
+                self._events = self._events[len(self._events) // 2:]
+            self._events.append(event)
+
+    def complete(
+        self,
+        name: str,
+        t0: float,
+        t1: float,
+        *,
+        cat: str = "host",
+        tid: Optional[int] = None,
+        args: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """One complete-duration event from two ``perf_counter`` readings."""
+        event: Dict[str, Any] = {
+            "name": name,
+            "ph": "X",
+            "ts": self._us(t0),
+            "dur": max(0.0, (t1 - t0) * 1e6),
+            "pid": self._pid,
+            "tid": tid if tid is not None else threading.get_ident() % (1 << 31),
+            "cat": cat,
+        }
+        if args:
+            event["args"] = args
+        self._append(event)
+
+    def instant(self, name: str, *, cat: str = "host",
+                args: Optional[Dict[str, Any]] = None) -> None:
+        event: Dict[str, Any] = {
+            "name": name,
+            "ph": "i",
+            "ts": self._us(time.perf_counter()),
+            "pid": self._pid,
+            "tid": threading.get_ident() % (1 << 31),
+            "cat": cat,
+            "s": "p",  # process-scoped instant
+        }
+        if args:
+            event["args"] = args
+        self._append(event)
+
+    @contextlib.contextmanager
+    def span(self, name: str, *, cat: str = "host",
+             args: Optional[Dict[str, Any]] = None):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.complete(name, t0, time.perf_counter(), cat=cat, args=args)
+
+    # -- serialization ---------------------------------------------------------
+
+    def flush(self) -> str:
+        """Write the collected events as Chrome trace JSON; returns the
+        path. Atomic (tmp + rename) so a capture killed mid-write never
+        leaves a half-JSON behind; safe to call repeatedly (checkpointing
+        the trace as a long run progresses)."""
+        with self._lock:
+            events = list(self._events)
+            dropped = self._dropped
+        doc = {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "producer": "ml_recipe_tpu.metrics.trace",
+                "dropped_events": dropped,
+            },
+        }
+        directory = os.path.dirname(self.path)
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, self.path)
+        return self.path
+
+    def close(self) -> str:
+        path = self.flush()
+        logger.info(f"Trace spans written to {path} (load in Perfetto).")
+        return path
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+
+# -- process-global instance (deep call sites: engine, prefetch worker) --------
+
+_active: Optional[TraceWriter] = None
+
+
+def install(tracer: Optional[TraceWriter]) -> Optional[TraceWriter]:
+    """Install (or clear, with None) the process-global tracer."""
+    global _active
+    _active = tracer
+    return tracer
+
+
+def current() -> Optional[TraceWriter]:
+    return _active
+
+
+@contextlib.contextmanager
+def span(name: str, *, cat: str = "host",
+         args: Optional[Dict[str, Any]] = None):
+    """Span against the process-global tracer; near-zero-cost no-op when
+    none is installed (the default)."""
+    tracer = _active
+    if tracer is None:
+        yield
+        return
+    with tracer.span(name, cat=cat, args=args):
+        yield
+
+
+def complete(name: str, t0: float, t1: float, *, cat: str = "host",
+             tid: Optional[int] = None,
+             args: Optional[Dict[str, Any]] = None) -> None:
+    tracer = _active
+    if tracer is not None:
+        tracer.complete(name, t0, t1, cat=cat, tid=tid, args=args)
+
+
+def instant(name: str, *, cat: str = "host",
+            args: Optional[Dict[str, Any]] = None) -> None:
+    tracer = _active
+    if tracer is not None:
+        tracer.instant(name, cat=cat, args=args)
+
+
+# -- xplane window (the trainer's staged on-chip capture) ----------------------
+
+
+class XplaneWindow:
+    """``jax.profiler`` capture over a fixed window of steady-state steps.
+
+    Replaces the trainer's hand-rolled start/stop flag pair: the window
+    opens before dispatching step ``start`` and closes (after a
+    ``block_until_ready`` sync) once step ``start + steps - 1`` has been
+    dispatched, so the xplane dump covers exactly ``steps`` full steps.
+    When a span tracer is installed the same boundaries are marked with
+    instant events, so host spans and the device capture line up on the
+    same step window in Perfetto.
+    """
+
+    def __init__(self, log_dir, *, start: int = 2, steps: int = 3):
+        self.log_dir = str(log_dir)
+        self.start = int(start)
+        self.steps = max(1, int(steps))
+        self.started = False
+        self.stopped = False
+
+    @property
+    def done(self) -> bool:
+        return self.stopped
+
+    def on_step_start(self, step_i: int) -> None:
+        if not self.started and step_i == self.start:
+            import jax
+
+            jax.profiler.start_trace(self.log_dir)
+            self.started = True
+            instant("xplane_capture_start", cat="train",
+                    args={"step": step_i, "dir": self.log_dir})
+
+    def on_step_end(self, step_i: int, sync_tree) -> bool:
+        """Close the window once the last captured step was dispatched;
+        returns True when it closed here."""
+        if not self.started or self.stopped:
+            return False
+        if step_i < self.start + self.steps - 1:
+            return False
+        self._stop(sync_tree)
+        logger.info(
+            f"Device trace (steps {self.start}-{self.start + self.steps - 1}) "
+            f"written to {self.log_dir}."
+        )
+        return True
+
+    def abort(self, sync_tree) -> None:
+        """Close a still-open window (epoch ended mid-capture)."""
+        if self.started and not self.stopped:
+            self._stop(sync_tree)
+            logger.info(f"Device trace written to {self.log_dir}.")
+
+    def _stop(self, sync_tree) -> None:
+        import jax
+
+        jax.block_until_ready(sync_tree)
+        jax.profiler.stop_trace()
+        self.stopped = True
+        instant("xplane_capture_stop", cat="train", args={"dir": self.log_dir})

@@ -27,11 +27,14 @@ restarts, and proves the recovery paths work.
 from .faults import FaultError, FaultPlan, fire, install_plan
 from .supervisor import (
     PREEMPT_EXIT_CODE,
+    STATE_FILENAME,
     Attempt,
     RetryPolicy,
     Supervisor,
     SupervisorResult,
     classify_exit,
+    peek_supervisor_state,
+    write_supervisor_state,
 )
 from .watchdog import WATCHDOG_EXIT_CODE, Watchdog
 
@@ -41,6 +44,7 @@ __all__ = [
     "FaultPlan",
     "PREEMPT_EXIT_CODE",
     "RetryPolicy",
+    "STATE_FILENAME",
     "Supervisor",
     "SupervisorResult",
     "WATCHDOG_EXIT_CODE",
@@ -48,4 +52,6 @@ __all__ = [
     "classify_exit",
     "fire",
     "install_plan",
+    "peek_supervisor_state",
+    "write_supervisor_state",
 ]

@@ -22,6 +22,7 @@ kill/stall scenarios through it in milliseconds-per-decision on CPU.
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 import os
 import random
@@ -29,11 +30,42 @@ import signal
 import subprocess
 import sys
 import time
+from datetime import datetime, timezone
 from typing import Callable, List, Optional, Sequence
 
 from .watchdog import WATCHDOG_EXIT_CODE
 
 logger = logging.getLogger(__name__)
+
+# JSON sidecar the supervisor keeps current next to the checkpoints, so the
+# training exporter (and humans) read restart counts / exit classifications
+# / backoff state without parsing logs. Written atomically (tmp + rename):
+# a reader never sees a torn document.
+STATE_FILENAME = "supervisor_state.json"
+
+
+def write_supervisor_state(path, state: dict) -> None:
+    """Atomically persist the supervisor's observable state."""
+    path = os.fspath(path)
+    directory = os.path.dirname(path)
+    if directory:
+        os.makedirs(directory, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(state, fh, indent=2)
+    os.replace(tmp, path)
+
+
+def peek_supervisor_state(path) -> Optional[dict]:
+    """Best-effort read of the sidecar; None when absent or unreadable
+    (an exporter scrape must never crash on a mid-replace race or a
+    corrupt file)."""
+    try:
+        with open(os.fspath(path)) as fh:
+            state = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return state if isinstance(state, dict) else None
 
 # A supervised child that caught SIGTERM/SIGINT, saved interrupt.ch and
 # unwound cleanly exits with this (EX_TEMPFAIL) instead of 0, so the
@@ -136,15 +168,55 @@ class Supervisor:
         policy: Optional[RetryPolicy] = None,
         attempt_timeout: Optional[float] = None,
         sleep: Callable[[float], None] = time.sleep,
+        state_path=None,
     ):
         self.launch = launch
         self.progress = progress
         self.policy = policy or RetryPolicy()
         self.attempt_timeout = attempt_timeout
         self.sleep = sleep
+        self.state_path = os.fspath(state_path) if state_path else None
         self._rng = random.Random(self.policy.seed)
         self._child = None
         self._terminate_signum: Optional[int] = None
+
+    def _persist_state(
+        self,
+        status: str,
+        attempts: List["Attempt"],
+        *,
+        restarts_used: int = 0,
+        no_progress_streak: int = 0,
+    ) -> None:
+        """Keep the JSON sidecar current; failures degrade observability,
+        never the supervision loop itself."""
+        if self.state_path is None:
+            return
+        last = attempts[-1] if attempts else None
+        state = {
+            "pid": os.getpid(),
+            "status": status,
+            "attempts": len(attempts),
+            "restarts_used": restarts_used,
+            "max_restarts": self.policy.max_restarts,
+            "no_progress_streak": no_progress_streak,
+            "crash_loop_window": self.policy.crash_loop_window,
+            "outcomes": [a.outcome for a in attempts],
+            "last_returncode": last.returncode if last else None,
+            "last_outcome": last.outcome if last else None,
+            "step": last.step_after if last else None,
+            "last_backoff_s": last.backoff if last else 0.0,
+            # wall-clock EVENT stamp (not an interval measurement): humans
+            # and dashboards correlate this with logs and scrape times
+            "updated_at": datetime.now(timezone.utc).isoformat(),
+        }
+        try:
+            write_supervisor_state(self.state_path, state)
+        except OSError as e:
+            logger.warning(
+                f"SUPERVISOR: could not persist state to "
+                f"{self.state_path}: {e}"
+            )
 
     # -- supervisor-level signals ----------------------------------------------
 
@@ -217,6 +289,13 @@ class Supervisor:
         no_progress_streak = 0
         restarts_used = 0  # only no-progress failures consume the budget
 
+        def persist(status: str) -> None:
+            self._persist_state(
+                status, attempts,
+                restarts_used=restarts_used,
+                no_progress_streak=no_progress_streak,
+            )
+
         def terminated(step) -> SupervisorResult:
             diagnosis = (
                 f"SUPERVISOR: terminated by signal {self._terminate_signum} "
@@ -225,10 +304,12 @@ class Supervisor:
             logger.error(diagnosis)
             sys.stderr.write(diagnosis + "\n")
             sys.stderr.flush()
+            persist("terminated")
             return SupervisorResult(
                 "terminated", attempts, diagnosis, signum=self._terminate_signum
             )
 
+        persist("running")
         attempt_i = 0
         while True:
             step_before = self.progress()
@@ -257,6 +338,7 @@ class Supervisor:
                     f"SUPERVISOR: clean exit after {len(attempts)} attempt(s) "
                     f"(final step: {step_after})."
                 )
+                persist(CLEAN)
                 return SupervisorResult(CLEAN, attempts)
 
             if self._terminate_signum is not None:
@@ -270,6 +352,7 @@ class Supervisor:
             else:
                 no_progress_streak += 1
                 restarts_used += 1
+            persist("running")
             logger.error(
                 f"SUPERVISOR: attempt {attempt_i} exited {rc} "
                 f"[{outcome}]; checkpoint step {step_before} -> {step_after} "
@@ -289,6 +372,7 @@ class Supervisor:
                 logger.error(diagnosis)
                 sys.stderr.write(diagnosis + "\n")
                 sys.stderr.flush()
+                persist("crash-loop")
                 return SupervisorResult("crash-loop", attempts, diagnosis)
 
             if restarts_used > p.max_restarts:
@@ -307,6 +391,7 @@ class Supervisor:
         logger.error(diagnosis)
         sys.stderr.write(diagnosis + "\n")
         sys.stderr.flush()
+        persist("retries-exhausted")
         return SupervisorResult("retries-exhausted", attempts, diagnosis)
 
 
@@ -398,5 +483,8 @@ def supervise_cli(params, argv: Sequence[str]) -> int:
         crash_loop_window=getattr(params, "crash_loop_window", 3),
         seed=getattr(params, "seed", None) or 0,
     )
-    result = Supervisor(launch, progress=progress, policy=policy).run()
+    result = Supervisor(
+        launch, progress=progress, policy=policy,
+        state_path=os.path.join(exp_dir, STATE_FILENAME),
+    ).run()
     return result.exit_code

@@ -100,6 +100,7 @@ class Watchdog:
         self._lock = threading.Lock()
         self._frames: List[_Frame] = []
         self._last_step: Optional[int] = None
+        self._last_beat: Optional[float] = None  # monotonic, see heartbeat_age
         self._fired = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -114,6 +115,7 @@ class Watchdog:
                 # frame must not shrink the deadline back to step size
                 timeout = self._frames[-1].timeout
             self._frames.append(_Frame(label, timeout or self.timeout))
+            self._last_beat = time.monotonic()
         self._ensure_thread()
 
     def disarm(self) -> None:
@@ -134,6 +136,7 @@ class Watchdog:
             if label is not None:
                 top.label = label
             top.deadline = time.monotonic() + top.timeout
+            self._last_beat = time.monotonic()
 
     @contextmanager
     def watch(self, label: str, timeout: Optional[float] = None):
@@ -146,6 +149,16 @@ class Watchdog:
     def note_progress(self, step: int) -> None:
         with self._lock:
             self._last_step = int(step)
+            self._last_beat = time.monotonic()
+
+    def heartbeat_age(self) -> Optional[float]:
+        """Seconds since the last sign of life (arm/tick/note_progress) —
+        the /metrics liveness gauge. None before any frame was ever armed
+        (nothing is being watched, so there is no heartbeat to age)."""
+        with self._lock:
+            if self._last_beat is None:
+                return None
+            return max(0.0, time.monotonic() - self._last_beat)
 
     def stop(self) -> None:
         """Shut the monitor thread down (tests; production lets the daemon

@@ -39,6 +39,7 @@ on CPU and the request path has no TPU-only branches.
 
 from __future__ import annotations
 
+import itertools
 import logging
 import threading
 import time
@@ -59,6 +60,7 @@ from ..parallel import build_mesh, make_global_array
 # the HBM byte arithmetic is shared with Trainer.preflight_train_step — one
 # definition of "projected per-device bytes" for train and predict steps
 # (utils/hbm.py: the serving path must not import the training stack)
+from ..metrics import trace as trace_mod
 from ..utils.hbm import device_hbm_bytes, preflight_bytes
 from .batcher import ChunkWork, DrainingError, MicroBatcher, QueueFullError
 from .bucketing import Bucket, BucketGrid, pad_trailing_batch
@@ -125,10 +127,17 @@ class _ChunkRef:
     key: Optional[str] = None
 
 
+# request ids key the serving trace spans (admission -> queue -> device ->
+# span_reduce -> respond belong to one request across threads); monotonic
+# per process, allocated lock-free
+_REQUEST_IDS = itertools.count(1)
+
+
 class RequestTicket:
     """Completion handle for one submitted request."""
 
     def __init__(self, *, n_chunks: int, question_len: int):
+        self.request_id = next(_REQUEST_IDS)
         self.n_chunks = n_chunks
         self.question_len = question_len
         self.created_at = time.perf_counter()
@@ -640,6 +649,19 @@ class QAEngine:
         Raises :class:`RequestRejected` (client error),
         :class:`QueueFullError` (backpressure) or :class:`DrainingError`
         (shutting down)."""
+        tracer = trace_mod.current()
+        if tracer is None:
+            return self._submit(question, document)
+        t0 = tracer.now()
+        ticket = self._submit(question, document)
+        tracer.complete(
+            "admission", t0, tracer.now(), cat="serve",
+            args={"request_id": ticket.request_id,
+                  "n_chunks": ticket.n_chunks},
+        )
+        return ticket
+
+    def _submit(self, question: str, document: str) -> RequestTicket:
         if self._closed:
             self.m_rejected_draining.inc()
             raise DrainingError("engine is shut down")
@@ -804,6 +826,20 @@ class QAEngine:
         n = len(works)
         batch = self.grid.batch_for(seq, n)
 
+        tracer = trace_mod.current()
+        t_flush0 = time.perf_counter()
+        if tracer is not None:
+            # per-chunk queue-wait spans: enqueued_at is a monotonic stamp,
+            # so map the WAIT duration onto the tracer clock ending now
+            waited_now = time.monotonic()
+            for w in works:
+                if w.enqueued_at:
+                    wait = max(0.0, waited_now - w.enqueued_at)
+                    tracer.complete(
+                        "queue", t_flush0 - wait, t_flush0, cat="serve",
+                        args={"request_id": w.payload.ticket.request_id},
+                    )
+
         ids = np.full((n, seq), self._pad_id, np.int32)
         lengths = np.empty((n,), np.int32)
         for i, w in enumerate(works):
@@ -819,9 +855,15 @@ class QAEngine:
             inputs = self._host_arrays(ids, lengths)
         inputs = pad_trailing_batch(inputs, batch)
 
+        t_dev0 = time.perf_counter()
         with self.mesh:
             dev = self._wire_pack(inputs)
             out = np.asarray(self._jit(self.params, dev))[:, :n]
+        if tracer is not None:
+            tracer.complete(
+                "device", t_dev0, time.perf_counter(), cat="serve",
+                args={"seq": seq, "rows": n, "batch": batch},
+            )
 
         self.m_batches.inc()
         self.m_last_batch_rows.set(n)
@@ -848,6 +890,11 @@ class QAEngine:
             for ticket, idx in offers:
                 if ticket._offer(idx, row):
                     self._finalize(ticket)
+        if tracer is not None:
+            tracer.complete(
+                "flush", t_flush0, time.perf_counter(), cat="serve",
+                args={"seq": seq, "rows": n},
+            )
 
     def _fail_batch(self, works: Sequence[ChunkWork], exc: BaseException) -> None:
         cache = self._chunk_cache
@@ -873,6 +920,12 @@ class QAEngine:
         """Reduce chunk outputs to the per-request best span, applying the
         predictor's validity rules in chunk order (ties resolve to the
         later chunk, exactly as the predictor's sequential stream does)."""
+        with trace_mod.span("span_reduce", cat="serve",
+                            args={"request_id": ticket.request_id,
+                                  "n_chunks": ticket.n_chunks}):
+            self._finalize_inner(ticket)
+
+    def _finalize_inner(self, ticket: RequestTicket) -> None:
         best_score = 0.0   # predictor: defaultdict(int) floor of 0
         best: Optional[Tuple[int, dict]] = None
         for idx in range(ticket.n_chunks):

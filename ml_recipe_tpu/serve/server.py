@@ -32,6 +32,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..metrics import trace as trace_mod
 from .batcher import DrainingError, QueueFullError
 from .engine import QAEngine, RequestRejected
 
@@ -128,8 +129,14 @@ class _QAHandler(BaseHTTPRequestHandler):
         self.server.handler_began()
         try:
             ticket = self.server.engine.submit(question, document)
-            result = ticket.result(timeout=self.server.request_timeout_s)
-            self._send_json(200, result.to_json())
+            # 'respond' span: admission done -> response bytes written (the
+            # handler-side wait the client actually experiences)
+            with trace_mod.span(
+                "respond", cat="serve",
+                args={"request_id": ticket.request_id},
+            ):
+                result = ticket.result(timeout=self.server.request_timeout_s)
+                self._send_json(200, result.to_json())
         except QueueFullError as e:
             self._send_json(
                 429, {"error": f"queue full: {e}"},

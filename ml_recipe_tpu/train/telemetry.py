@@ -1,0 +1,280 @@
+"""Training-plane telemetry: the trainer's /metrics surface.
+
+The serving plane has had a first-party Prometheus registry since PR 3;
+the training plane — the thing that runs for days on pod slices — was
+observable only through tqdm postfix lines and the TensorBoard writer.
+:class:`TrainTelemetry` gives it the same surface: a registry of training
+metrics (served by ``metrics.exporter.MetricsExporter`` from
+``--metrics_port``) fed per consumed step by the trainer with a wall-time
+breakdown —
+
+- ``data_wait``: blocked on the loader / prefetch queue,
+- ``host``: collate + micro split + host→device placement,
+- ``device``: step dispatch + device execution (the ``StepTimer``
+  block-until-ready discipline, so async dispatch cannot fake it),
+
+plus tokens/sec, padding waste, loss-scale adjustments, checkpoint
+save/restore durations, and — at scrape time — the watchdog heartbeat age
+and the supervisor's restart/exit-classification counts read cross-process
+from its JSON sidecar (``resilience.supervisor.peek_supervisor_state``).
+
+Everything here is opt-in and host-side-only: with no telemetry attached
+the trainer's step loop is bit-identical to the untelemetered path, and
+with it attached only timing/blocking changes — never batch contents,
+order, or arithmetic.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Dict, Optional
+
+from ..metrics.anomaly import AnomalyReport, SlowStepDetector
+from ..metrics.registry import Registry
+
+logger = logging.getLogger(__name__)
+
+# step-scale histogram bounds: 5 ms .. 120 s (a pod-scale step with a
+# checkpoint barrier in the tail is seconds, not the serving plane's ms)
+STEP_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    120.0,
+)
+
+# checkpoint I/O is far slower than a step: 50 ms .. 10 min
+CKPT_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0, 600.0)
+
+
+class TrainTelemetry:
+    """Registry + per-step accounting + slow-step anomaly detection."""
+
+    def __init__(
+        self,
+        *,
+        registry: Optional[Registry] = None,
+        process_index: int = 0,
+        process_count: int = 1,
+        anomaly_factor: float = 3.0,
+        anomaly_window: int = 64,
+        anomaly_min_steps: int = 8,
+        watchdog=None,
+        supervisor_state_path=None,
+    ):
+        self.registry = registry if registry is not None else Registry()
+        self.watchdog = watchdog
+        self.supervisor_state_path = (
+            str(supervisor_state_path) if supervisor_state_path else None
+        )
+        self.detector = SlowStepDetector(
+            factor=anomaly_factor,
+            window=anomaly_window,
+            min_steps=anomaly_min_steps,
+        )
+        self._last_loss_scale: Optional[float] = None
+
+        m = self.registry
+        self.m_steps = m.counter(
+            "train_steps_total", "Consumed optimizer steps this process.")
+        self.m_global_step = m.gauge(
+            "train_global_step", "Current global optimizer step.")
+        self.m_step = m.histogram(
+            "train_step_seconds",
+            "Per-step wall time: data wait + host + device (host excluded "
+            "when the prefetch thread overlaps it with device compute).",
+            STEP_BUCKETS)
+        self.m_data_wait = m.histogram(
+            "train_step_data_wait_seconds",
+            "Per-step time blocked on the loader / prefetch queue.",
+            STEP_BUCKETS)
+        self.m_host = m.histogram(
+            "train_step_host_seconds",
+            "Per-step collate + micro split + host-to-device placement time.",
+            STEP_BUCKETS)
+        self.m_device = m.histogram(
+            "train_step_device_seconds",
+            "Per-step dispatch + device execution time (block-until-ready).",
+            STEP_BUCKETS)
+        self.m_tokens_per_sec = m.gauge(
+            "train_tokens_per_sec",
+            "Real (non-pad) input tokens per second, last consumed step.")
+        self.m_examples_per_sec = m.gauge(
+            "train_examples_per_sec",
+            "Examples (rows / packed segments) per second, last step.")
+        self.m_padding_waste = m.gauge(
+            "train_padding_waste_pct",
+            "Share of step input tokens that are padding, last step (%).")
+        self.m_loss = m.gauge(
+            "train_loss", "Running mean training loss (epoch meter).")
+        self.m_lr = m.gauge(
+            "train_lr", "Learning rate at the last consumed step.")
+        self.m_loss_scale = m.gauge(
+            "train_loss_scale",
+            "Current loss scale (0 when loss scaling is off).")
+        self.m_loss_scale_adjustments = m.counter(
+            "train_loss_scale_adjustments_total",
+            "Dynamic loss-scale changes (growth or overflow backoff).")
+        self.m_slow_steps = m.counter(
+            "train_slow_steps_total",
+            "Steps flagged anomalous by the rolling median+MAD detector.")
+        self.m_ckpt_save = m.histogram(
+            "train_checkpoint_save_seconds",
+            "Checkpoint save durations.", CKPT_BUCKETS)
+        self.m_ckpt_restore = m.histogram(
+            "train_checkpoint_restore_seconds",
+            "Checkpoint restore durations.", CKPT_BUCKETS)
+        self.m_heartbeat_age = m.gauge(
+            "train_watchdog_heartbeat_age_seconds",
+            "Seconds since the step watchdog last saw progress "
+            "(-1: no watchdog armed).")
+        self.m_sup_restarts = m.gauge(
+            "train_supervisor_restarts",
+            "Supervisor restart budget consumed (no-progress failures), "
+            "from the supervisor JSON sidecar (-1: no sidecar).")
+        self.m_sup_attempts = m.gauge(
+            "train_supervisor_attempts",
+            "Supervisor attempts launched so far (-1: no sidecar).")
+        self.m_sup_preempted = m.gauge(
+            "train_supervisor_exits_preempted",
+            "Child exits the supervisor classified as preemptions.")
+        self.m_sup_hang = m.gauge(
+            "train_supervisor_exits_hang",
+            "Child exits the supervisor classified as hangs "
+            "(watchdog aborts).")
+        self.m_sup_crash = m.gauge(
+            "train_supervisor_exits_crash",
+            "Child exits the supervisor classified as crashes.")
+        self.m_process = m.info(
+            "train_process_info",
+            "Identity of this training process on the mesh.",
+            {
+                "process_index": str(process_index),
+                "process_count": str(process_count),
+            },
+        )
+        self.m_heartbeat_age.set(-1.0)
+        self.m_sup_restarts.set(-1.0)
+        self.m_sup_attempts.set(-1.0)
+
+    # -- per-step feed (train loop) --------------------------------------------
+
+    def observe_step(
+        self,
+        step: int,
+        *,
+        data_wait_s: float,
+        host_s: float,
+        device_s: float,
+        examples: int = 0,
+        real_tokens: int = 0,
+        total_tokens: int = 0,
+        host_overlapped: bool = False,
+    ) -> Optional[AnomalyReport]:
+        """Feed one consumed step's breakdown; total step time is defined
+        as the sum of the components on the critical path (pinned by the
+        accounting test). ``host_overlapped=True`` (the device-prefetch
+        path) excludes ``host_s`` from the total and the detector
+        baseline: placement ran on the prefetch thread UNDER the previous
+        step's device time, so counting it would overstate the step wall
+        — a prefetch thread that falls behind surfaces as data wait. The
+        host histogram itself still records every placement. Returns the
+        anomaly report when the detector fired (already logged and counted
+        here)."""
+        total = data_wait_s + device_s
+        breakdown = {"data_wait": data_wait_s, "device": device_s}
+        if not host_overlapped:
+            total += host_s
+            breakdown["host"] = host_s
+        self.m_steps.inc()
+        self.m_global_step.set(step)
+        self.m_step.observe(total)
+        self.m_data_wait.observe(data_wait_s)
+        self.m_host.observe(host_s)
+        self.m_device.observe(device_s)
+        if total > 0:
+            if real_tokens:
+                self.m_tokens_per_sec.set(real_tokens / total)
+            if examples:
+                self.m_examples_per_sec.set(examples / total)
+        if total_tokens:
+            self.m_padding_waste.set(
+                100.0 * (1.0 - real_tokens / total_tokens))
+
+        report = self.detector.update(step, total, breakdown)
+        if report is not None:
+            self.m_slow_steps.inc()
+            logger.warning(report.message())
+        return report
+
+    def observe_scalars(self, host_values: Dict[str, float]) -> None:
+        """Per-consumed-step scalar taps from the train step's host fetch
+        (loss, lr, loss scale)."""
+        loss = host_values.get("loss")
+        if loss is not None:
+            value = float(loss)
+            if math.isfinite(value):
+                self.m_loss.set(value)
+        lr = host_values.get("lr")
+        if lr is not None:
+            self.m_lr.set(float(lr))
+        scale = host_values.get("loss_scale")
+        if scale is not None:
+            value = float(scale)
+            self.m_loss_scale.set(value)
+            if (
+                self._last_loss_scale is not None
+                and value != self._last_loss_scale
+            ):
+                self.m_loss_scale_adjustments.inc()
+            self._last_loss_scale = value
+
+    # -- checkpoint + scrape-time feeds ----------------------------------------
+
+    def observe_checkpoint_save(self, seconds: float) -> None:
+        self.m_ckpt_save.observe(seconds)
+
+    def observe_checkpoint_restore(self, seconds: float) -> None:
+        self.m_ckpt_restore.observe(seconds)
+
+    def refresh(self) -> None:
+        """Scrape-time gauges: watchdog heartbeat age + supervisor sidecar
+        (registered as the exporter's pre-render hook)."""
+        age = None
+        if self.watchdog is not None:
+            age = self.watchdog.heartbeat_age()
+        self.m_heartbeat_age.set(age if age is not None else -1.0)
+
+        if self.supervisor_state_path is None:
+            return
+        from ..resilience.supervisor import peek_supervisor_state
+
+        state = peek_supervisor_state(self.supervisor_state_path)
+        if state is None:
+            return
+        self.m_sup_restarts.set(float(state.get("restarts_used", 0)))
+        self.m_sup_attempts.set(float(state.get("attempts", 0)))
+        outcomes = state.get("outcomes", [])
+        self.m_sup_preempted.set(float(outcomes.count("preempted")))
+        self.m_sup_hang.set(float(outcomes.count("hang")))
+        self.m_sup_crash.set(float(outcomes.count("crash")))
+
+    # -- bench surface ----------------------------------------------------------
+
+    def breakdown_summary(self) -> dict:
+        """Step-time breakdown percentiles + anomaly count for the bench
+        JSON line (seconds)."""
+        def q(hist, p):
+            value = hist.quantile(p)
+            return round(value, 6) if value is not None else None
+
+        return {
+            "step_p50_s": q(self.m_step, 0.5),
+            "step_p95_s": q(self.m_step, 0.95),
+            "data_wait_p50_s": q(self.m_data_wait, 0.5),
+            "data_wait_p95_s": q(self.m_data_wait, 0.95),
+            "host_p50_s": q(self.m_host, 0.5),
+            "host_p95_s": q(self.m_host, 0.95),
+            "device_p50_s": q(self.m_device, 0.5),
+            "device_p95_s": q(self.m_device, 0.95),
+            "slow_step_anomalies": self.detector.anomalies,
+        }

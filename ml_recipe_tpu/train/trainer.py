@@ -31,7 +31,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from contextlib import nullcontext
 from typing import Any, Optional
 
@@ -51,6 +51,8 @@ from ..data.packing import (
 )
 from ..losses import PackedWeightedLoss
 from ..metrics import AverageMeter
+from ..metrics import trace as trace_mod
+from ..metrics.trace import XplaneWindow
 from ..resilience.faults import fire as _fault
 from ..parallel import build_mesh, gather_to_host, make_global_array, shard_params
 from ..parallel.sharding import (
@@ -220,6 +222,16 @@ class Trainer:
     # (with stacks dumped) for the supervisor to restart, instead of
     # wedging. None = zero overhead.
     watchdog: Any = None
+
+    # Optional train.telemetry.TrainTelemetry (--metrics_port): per-step
+    # wall-time breakdown (data wait / host / device), tokens/sec, padding
+    # waste, checkpoint durations, and the slow-step anomaly detector, all
+    # exported at /metrics. None (the default) = zero instrumentation, the
+    # step loop is untouched. When attached, the step loop blocks on each
+    # step's results before dispatching the next (the StepTimer
+    # block-until-ready discipline — async dispatch cannot fake device
+    # time), trading the one-step metric lag for honest attribution.
+    telemetry: Any = None
 
     # Length-bucketed token-budget batching (data/bucketing.py): a sorted
     # seq grid (e.g. [128, 256, 384, 512]) or None for pad-to-max batching
@@ -1280,13 +1292,26 @@ class Trainer:
             tqdm_data = tqdm(iterator, desc=f"Train (epoch #{epoch_i} / {self.n_epochs})")
             iterator = tqdm_data
 
-        trace_started = trace_stopped = self.trace_dir is None  # disabled = done
         # steady-state steps 2-4 when the epoch has them; short/debug epochs
         # (the smoke config breaks after one step) trace from step 0 instead
         # of silently capturing nothing
         trace_from = (
             0 if self.debug or len(self.train_dataloader) < 5 else 2
         )
+        # xplane capture window (epoch 1 only), refactored onto
+        # metrics.trace.XplaneWindow: host spans and the jax.profiler
+        # capture mark the same step boundaries
+        xplane = (
+            XplaneWindow(str(self.trace_dir), start=trace_from, steps=3)
+            if self.trace_dir is not None and epoch_i == 1
+            else None
+        )
+        tele = self.telemetry
+        tracer = trace_mod.current()
+        # either observability plane forces the honest-timing discipline:
+        # block on each step's results so 'device' is execution, not
+        # dispatch (costs the one-step metric lag; off-path untouched)
+        instrument = tele is not None or tracer is not None
         log_every = max(1, int(self.log_every))
         last_consumed = [None]  # last consumed step no (for the final write)
 
@@ -1305,6 +1330,8 @@ class Trainer:
                     # count to stay per-example-correct; plain batches are
                     # equal-sized (weight 1 = historical arithmetic)
                     avg_meters[k].update(float(v), rows if weighted else 1)
+            if tele is not None:
+                tele.observe_scalars(host_values)
             if self.on_train_metrics is not None:
                 self.on_train_metrics(avg_meters, step=step_no)
             last_consumed[0] = step_no
@@ -1325,12 +1352,20 @@ class Trainer:
             consume, total=None if weighted else len(self.train_dataloader)
         )
 
+        # instrumented accounting, FIFO-matched to batch order (one worker
+        # thread, bounded queue — the prefetcher's ordering guarantee):
+        # place() appends, run_step() pops the stats for the batch it runs
+        host_stats = deque()
+        fetch_wait = [0.0]      # time blocked obtaining the current batch
+        host_inline = [True]    # place() ran on the consumer thread?
+
         def place(batch):
             """Host batch -> placed global arrays + example count (runs on
             the prefetch thread when device_prefetch > 0, inline otherwise —
             same code either way, which is what makes the trajectories
             bit-identical). The count is what the meters weight by: rows
             for plain/bucketed batches, REAL segments for packed ones."""
+            t0 = time.perf_counter() if instrument else 0.0
             inputs, labels, meta = self._normalize_batch(batch)
             if isinstance(meta, PackedBatch):
                 rows = meta.segments
@@ -1338,34 +1373,88 @@ class Trainer:
                 rows = meta.rows
             else:
                 rows = int(np.shape(next(iter(inputs.values())))[0])
-            return (
+            if instrument:
+                mask = inputs.get("attention_mask")
+                real_tokens = int(np.asarray(mask).sum()) if mask is not None else 0
+                total_tokens = int(np.asarray(mask).size) if mask is not None else 0
+            placed = (
                 self._global_batch(self._split_micro(inputs), leading_accum=True),
                 self._global_batch(self._split_micro(labels), leading_accum=True),
                 rows,
             )
+            if instrument:
+                t1 = time.perf_counter()
+                host_stats.append((t1 - t0, real_tokens, total_tokens))
+                if tracer is not None:
+                    # emitted from whichever thread ran the placement, so
+                    # Perfetto shows prefetch overlap on its own track
+                    tracer.complete("place", t0, t1, cat="train")
+            return placed
+
+        def timed_fetch(iterator):
+            """Yield from ``iterator``, recording per-item blocked time
+            (loader wait; + placement when inline) into ``fetch_wait``."""
+            iterator = iter(iterator)
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    item = next(iterator)
+                except StopIteration:
+                    return
+                t1 = time.perf_counter()
+                fetch_wait[0] = t1 - t0
+                if tracer is not None:
+                    tracer.complete("data_wait", t0, t1, cat="train")
+                yield item
 
         step_i = [0]
 
         def run_step(placed) -> None:
-            nonlocal trace_started, trace_stopped
             dev_inputs, dev_labels, rows = placed
-            if not trace_started and epoch_i == 1 and step_i[0] == trace_from:
-                jax.profiler.start_trace(str(self.trace_dir))
-                trace_started = True
+            if xplane is not None:
+                xplane.on_step_start(step_i[0])
 
+            t0 = time.perf_counter() if instrument else 0.0
             self.params, self.opt_state, values = self._jit_train_step(
                 self.params, self.opt_state, dev_inputs, dev_labels,
                 self.global_step,
             )
-
-            if trace_started and not trace_stopped and step_i[0] >= trace_from + 2:
+            if instrument:
+                # StepTimer discipline: block before reading the clock, so
+                # 'device' is actual execution time under async dispatch
                 jax.block_until_ready(values)
-                jax.profiler.stop_trace()
-                trace_stopped = True
-                logger.info(
-                    f"Device trace (steps {trace_from}-{trace_from + 2}) "
-                    f"written to {self.trace_dir}."
+                t1 = time.perf_counter()
+                host_s, real_tokens, total_tokens = (
+                    host_stats.popleft() if host_stats else (0.0, 0, 0)
                 )
+                # inline placement runs inside the fetch wait — subtract it
+                # so the three components partition the step wall exactly
+                wait_s = fetch_wait[0]
+                data_wait_s = (
+                    max(0.0, wait_s - host_s) if host_inline[0] else wait_s
+                )
+                fetch_wait[0] = 0.0
+                if tracer is not None:
+                    tracer.complete(
+                        "step", t0, t1, cat="train",
+                        args={"step": self.global_step, "rows": rows},
+                    )
+                if tele is not None:
+                    tele.observe_step(
+                        self.global_step,
+                        data_wait_s=data_wait_s,
+                        host_s=host_s,
+                        device_s=t1 - t0,
+                        examples=rows,
+                        real_tokens=real_tokens,
+                        total_tokens=total_tokens,
+                        # prefetch-thread placement overlaps the previous
+                        # step's device time — it is not on the step wall
+                        host_overlapped=not host_inline[0],
+                    )
+
+            if xplane is not None:
+                xplane.on_step_end(step_i[0], values)
 
             lag.feed(values, self.global_step, rows)
             self.global_step += 1
@@ -1436,8 +1525,11 @@ class Trainer:
                             host_iter, place, depth=depth
                         )
                         placed_iter = iter(prefetcher)
+                        host_inline[0] = False
                     else:
                         placed_iter = (place(b) for b in host_iter)
+                    if instrument:
+                        placed_iter = timed_fetch(placed_iter)
                     for placed in placed_iter:
                         _fault("trainer.step")
                         tick(f"train step {self.global_step} (epoch {epoch_i})")
@@ -1463,11 +1555,8 @@ class Trainer:
                         close_err = e
                 lag.flush()
 
-                if trace_started and not trace_stopped:  # ended mid-capture
-                    jax.block_until_ready(self.params)
-                    jax.profiler.stop_trace()
-                    trace_stopped = True
-                    logger.info(f"Device trace written to {self.trace_dir}.")
+                if xplane is not None:  # close a window still open mid-epoch
+                    xplane.abort(self.params)
 
                 if last_consumed[0] is not None and (
                     (last_consumed[0] + 1) % log_every != 0
@@ -1747,7 +1836,11 @@ class Trainer:
         # misclassified as a hang and crash-looped. Barriers inside inherit
         # this budget (watchdog.arm nested-frame default).
         extra = {"opt_sharding": self.effective_opt_sharding}
-        with self._watched(f"checkpoint save {path_}", scale=8.0):
+        t0 = time.perf_counter()
+        with self._watched(f"checkpoint save {path_}", scale=8.0), \
+                trace_mod.span("checkpoint_save", cat="train",
+                               args={"path": str(path_),
+                                     "step": self.global_step}):
             if self.sharded_checkpoint:
                 from .checkpoint import save_state_dict_sharded
 
@@ -1759,26 +1852,34 @@ class Trainer:
                     global_step=self.global_step,
                     extra=extra,
                 )
-                return
-            _save_ckpt(
-                path_,
-                params=self.params,
-                opt_state=opt_state,
-                loss_scale=ls_state,
-                global_step=self.global_step,
-                is_primary=self.is_primary,
-                extra=extra,
-            )
+            else:
+                _save_ckpt(
+                    path_,
+                    params=self.params,
+                    opt_state=opt_state,
+                    loss_scale=ls_state,
+                    global_step=self.global_step,
+                    is_primary=self.is_primary,
+                    extra=extra,
+                )
+        if self.telemetry is not None:
+            self.telemetry.observe_checkpoint_save(time.perf_counter() - t0)
 
     def load_state_dict(self, path_):
+        t0 = time.perf_counter()
         live_opt, live_ls = self._split_ls()
-        params, opt_state, ls_state, global_step = _load_ckpt(
-            path_,
-            params=self.params,
-            opt_state=live_opt,
-            loss_scale=live_ls,
-            drop_optimizer=self.drop_optimizer,
-        )
+        with trace_mod.span("checkpoint_restore", cat="train",
+                            args={"path": str(path_)}):
+            params, opt_state, ls_state, global_step = _load_ckpt(
+                path_,
+                params=self.params,
+                opt_state=live_opt,
+                loss_scale=live_ls,
+                drop_optimizer=self.drop_optimizer,
+            )
+        if self.telemetry is not None:
+            self.telemetry.observe_checkpoint_restore(
+                time.perf_counter() - t0)
         if global_step is None:
             return
         if not self.drop_optimizer and live_opt is not None and opt_state is not None:

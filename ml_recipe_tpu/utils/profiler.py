@@ -42,6 +42,8 @@ class StepTimer:
     (compilation).
     """
 
+    _warned_no_jax = False  # once per process, not once per timer
+
     def __init__(self, warmup: int = 1):
         self.warmup = warmup
         self.count = 0
@@ -53,12 +55,20 @@ class StepTimer:
 
     def stop(self, result=None) -> float:
         if result is not None:
+            # only a missing jax is survivable (host-only environments):
+            # anything else — e.g. a typo'd result tree — must surface, not
+            # silently degrade every measurement to dispatch-only timing
             try:
                 import jax
-
+            except ImportError:
+                if not StepTimer._warned_no_jax:
+                    StepTimer._warned_no_jax = True
+                    logger.warning(
+                        "StepTimer: jax unavailable; timings cover Python "
+                        "dispatch only, not device execution."
+                    )
+            else:
                 jax.block_until_ready(result)
-            except Exception:
-                pass
         assert self._t0 is not None, "StepTimer.stop() without start()"
         dt = time.perf_counter() - self._t0
         self._t0 = None

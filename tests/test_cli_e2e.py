@@ -375,3 +375,97 @@ def test_bench_converge_mode_smoke():
     assert rec["loss_final"] < rec["loss_initial"]
     assert len(rec["loss_curve_per_epoch"]) >= 1
     assert rec["steps"] >= 40
+
+
+def test_cli_train_observability_plane_scrapeable(e2e, monkeypatch):
+    """ISSUE-10 acceptance: a real training run with --metrics_port serves
+    a scrapeable /metrics carrying the step-time breakdown, watchdog
+    heartbeat age, and supervisor gauges, /healthz answers with live
+    trainer state, and --trace_spans leaves valid Chrome trace JSON
+    covering the step window. The scrape happens through the LIVE HTTP
+    listener (hooked just before its shutdown, when the run's metrics are
+    all in)."""
+    import json
+    import urllib.request
+
+    tmp, cfg, _ = e2e
+    from ml_recipe_tpu.cli import train
+    from ml_recipe_tpu.metrics import exporter as exporter_mod
+
+    scraped = {}
+    real_close = exporter_mod.MetricsExporter.close
+
+    def scraping_close(self):
+        try:
+            base = f"http://127.0.0.1:{self.port}"
+            with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+                scraped["metrics"] = r.read().decode()
+            with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+                scraped["health"] = json.loads(r.read())
+        finally:
+            real_close(self)
+
+    monkeypatch.setattr(
+        exporter_mod.MetricsExporter, "close", scraping_close)
+
+    spans_dir = tmp / "spans"
+    monkeypatch.setattr(sys, "argv", [
+        "train", "-c", str(cfg),
+        "--experiment_name", "obs",
+        "--metrics_port", "0",              # ephemeral port
+        "--trace_spans", str(spans_dir),
+        "--watchdog_timeout", "600",
+    ])
+    train.cli()
+
+    text = scraped["metrics"]
+    # breakdown histograms observed once per consumed step
+    for series in ("train_step_seconds", "train_step_data_wait_seconds",
+                   "train_step_host_seconds", "train_step_device_seconds"):
+        count_line = [l for l in text.splitlines()
+                      if l.startswith(f"{series}_count ")]
+        assert count_line, series
+        assert float(count_line[0].split()[-1]) > 0, series
+    # the armed watchdog produced a real heartbeat age (not the -1 unknown)
+    age_line = [l for l in text.splitlines()
+                if l.startswith("train_watchdog_heartbeat_age_seconds ")]
+    assert age_line and float(age_line[0].split()[-1]) >= 0
+    # no supervisor sidecar in this run: gauges report the -1 sentinel
+    assert "train_supervisor_restarts -1" in text
+    assert 'train_process_info{process_count="1",process_index="0"} 1' in text
+
+    assert scraped["health"]["status"] == "ok"
+    assert scraped["health"]["global_step"] > 0
+
+    trace_file = spans_dir / "train_trace_p0.json"
+    assert trace_file.exists()
+    doc = json.loads(trace_file.read_text())
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"data_wait", "place", "step", "checkpoint_save"} <= names
+
+
+def test_cli_train_startup_failure_uninstalls_tracer(e2e, monkeypatch):
+    """Review regression: a startup failure AFTER the tracer/exporter come
+    up (here: a corrupt --last restore) must still uninstall the
+    process-global tracer and flush the span file — otherwise every later
+    in-process run silently flips to the instrumented path."""
+    import pytest
+
+    from ml_recipe_tpu.cli import train
+    from ml_recipe_tpu.metrics import trace as trace_mod
+
+    tmp, cfg, _ = e2e
+    bogus = tmp / "not_a_checkpoint.ch"
+    bogus.write_text("garbage")
+    spans_dir = tmp / "fail_spans"
+    monkeypatch.setattr(sys, "argv", [
+        "train", "-c", str(cfg),
+        "--experiment_name", "obs_fail",
+        "--metrics_port", "0",
+        "--trace_spans", str(spans_dir),
+        "--last", str(bogus),
+    ])
+    with pytest.raises(Exception):
+        train.cli()
+    assert trace_mod.current() is None
+    assert (spans_dir / "train_trace_p0.json").exists()  # flushed on unwind

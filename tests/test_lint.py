@@ -44,6 +44,32 @@ def test_check_bare_except_catches_violations(tmp_path):
     assert "bad.py" in out.stdout
 
 
+def test_interval_measurements_use_perf_counter():
+    """Observability satellite: interval measurements must read
+    ``time.perf_counter()`` (monotonic, high resolution), never
+    ``time.time()`` — the wall clock jumps under NTP slew and makes step
+    timings silently wrong, which then poisons the /metrics breakdown and
+    the slow-step detector baseline. Allowlist: ``train/writer.py`` stamps
+    wall-clock EVENT times into TensorBoard records (an event stamp, not
+    an interval — the one legitimate use)."""
+    allowlist = {"ml_recipe_tpu/train/writer.py"}
+    files = sorted((_REPO / "ml_recipe_tpu").rglob("*.py"))
+    files.append(_REPO / "bench.py")
+    offenders = []
+    for path in files:
+        rel = path.relative_to(_REPO).as_posix()
+        if rel in allowlist:
+            continue
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            if "time.time()" in line:
+                offenders.append(f"{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "time.time() used where an interval clock belongs (use "
+        "time.perf_counter(), or allowlist a genuine wall-clock event "
+        f"stamp with a reason): {offenders}"
+    )
+
+
 def test_all_parser_flags_documented_in_readme():
     """ISSUE-5 satellite: every ``add_argument`` flag in config/parser.py
     must appear in README.md (the subsystem sections or the generated

@@ -1,0 +1,531 @@
+"""Unified observability plane tests (metrics/ + train/telemetry.py).
+
+Covers the ISSUE-10 acceptance surface on the CPU mesh: registry-lift
+back-compat (serve.metrics is a shim over metrics.registry), the
+step-time breakdown accounting (components partition the step wall), the
+slow-step anomaly detector (fires on a synthetic stall, quiet on steady
+traces), Chrome trace-event JSON validity for BOTH planes' span streams,
+the /metrics exporter end-to-end scrape, the supervisor JSON sidecar, the
+watchdog heartbeat age, the StepTimer exception-narrowing satellite, and
+the off == bit-identical trajectory pin.
+"""
+
+import json
+import logging
+import threading
+import urllib.request
+from pathlib import Path
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+
+from ml_recipe_tpu.metrics import trace as trace_mod
+from ml_recipe_tpu.metrics.anomaly import SlowStepDetector
+from ml_recipe_tpu.metrics.exporter import MetricsExporter
+from ml_recipe_tpu.metrics.registry import Registry
+from ml_recipe_tpu.metrics.trace import TraceWriter
+from ml_recipe_tpu.train.telemetry import TrainTelemetry
+
+from helpers import make_tokenizer
+from test_trainer import _make_trainer, _param_snapshot
+
+_REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture
+def tracer(tmp_path):
+    """Install a process-global TraceWriter; always uninstall after."""
+    writer = trace_mod.install(
+        TraceWriter(str(tmp_path / "trace.json"), process_name="test"))
+    try:
+        yield writer
+    finally:
+        trace_mod.install(None)
+
+
+def _validate_chrome_trace(path):
+    """Assert the file parses as Chrome trace-event JSON and return the
+    events (the schema Perfetto's importer requires: traceEvents list,
+    every event carrying name/ph/ts/pid/tid; complete events a dur)."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    assert isinstance(doc, dict)
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events
+    for event in events:
+        assert isinstance(event["name"], str) and event["name"]
+        assert event["ph"] in ("X", "i")
+        assert isinstance(event["ts"], (int, float)) and event["ts"] >= 0
+        assert isinstance(event["pid"], int)
+        assert isinstance(event["tid"], int)
+        if event["ph"] == "X":
+            assert isinstance(event["dur"], (int, float))
+            assert event["dur"] >= 0
+    return events
+
+
+# ---------------------------------------------------------------------------
+# registry lift
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.unit
+def test_registry_lift_backcompat():
+    """serve.metrics must remain a faithful shim: same classes (not
+    copies), so isinstance checks and registries interoperate across both
+    planes."""
+    from ml_recipe_tpu import metrics as metrics_pkg
+    from ml_recipe_tpu.metrics import registry as shared
+    from ml_recipe_tpu.serve import metrics as shim
+
+    for name in ("Counter", "Gauge", "Histogram", "Info", "Registry"):
+        assert getattr(shim, name) is getattr(shared, name), name
+        assert getattr(metrics_pkg, name) is getattr(shared, name), name
+    assert shim.DEFAULT_BUCKETS == shared.DEFAULT_BUCKETS
+
+    # the serve package surface (serve/__init__.py) still resolves
+    from ml_recipe_tpu.serve import Counter, Registry as ServeRegistry
+
+    assert ServeRegistry is shared.Registry
+    assert Counter is shared.Counter
+
+
+# ---------------------------------------------------------------------------
+# trace writer
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.unit
+def test_trace_writer_chrome_schema(tmp_path):
+    writer = TraceWriter(str(tmp_path / "t.json"))
+    with writer.span("outer", cat="test", args={"k": 1}):
+        with writer.span("inner", cat="test"):
+            pass
+    t0 = writer.now()
+    writer.complete("explicit", t0, t0 + 0.001, cat="test",
+                    args={"request_id": 7})
+    writer.instant("marker", cat="test")
+    path = writer.close()
+    events = _validate_chrome_trace(path)
+    names = [e["name"] for e in events]
+    assert set(names) == {"outer", "inner", "explicit", "marker"}
+    explicit = next(e for e in events if e["name"] == "explicit")
+    assert explicit["args"]["request_id"] == 7
+    assert abs(explicit["dur"] - 1000.0) < 1.0  # 1 ms in microseconds
+
+
+@pytest.mark.unit
+def test_trace_module_noops_without_tracer():
+    assert trace_mod.current() is None
+    with trace_mod.span("nothing"):
+        pass
+    trace_mod.complete("nothing", 0.0, 1.0)
+    trace_mod.instant("nothing")  # none of these may raise or allocate state
+
+
+@pytest.mark.unit
+def test_trace_writer_bounds_memory(tmp_path):
+    writer = TraceWriter(str(tmp_path / "b.json"))
+    for i in range(trace_mod._MAX_EVENTS + 10):
+        writer.complete("e", 0.0, 0.0)
+    assert len(writer) <= trace_mod._MAX_EVENTS
+    with open(writer.flush()) as fh:
+        assert json.load(fh)["otherData"]["dropped_events"] > 0
+
+
+# ---------------------------------------------------------------------------
+# anomaly detector
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.unit
+def test_anomaly_detector_quiet_on_steady_trace():
+    det = SlowStepDetector(factor=3.0, window=64, min_steps=8)
+    rng = np.random.default_rng(0)
+    for i in range(200):  # ±5% jitter around 100 ms: healthy steady state
+        t = 0.1 * (1.0 + 0.05 * float(rng.uniform(-1, 1)))
+        assert det.update(i, t, {"data_wait": 0.01, "host": 0.02,
+                                 "device": t - 0.03}) is None
+    assert det.anomalies == 0
+
+
+@pytest.mark.unit
+def test_anomaly_detector_fires_on_stall_with_attribution():
+    det = SlowStepDetector(factor=3.0, window=64, min_steps=8)
+    for i in range(32):
+        det.update(i, 0.1, {"data_wait": 0.01, "host": 0.02, "device": 0.07})
+    # injected loader stall: data_wait explodes, device unchanged
+    report = det.update(
+        32, 0.5, {"data_wait": 0.41, "host": 0.02, "device": 0.07})
+    assert report is not None
+    assert report.attribution == "data_wait"
+    assert report.step == 32
+    assert report.total_s == pytest.approx(0.5)
+    assert report.threshold_s <= 0.5
+    assert "SLOW STEP 32" in report.message()
+    assert det.anomalies == 1
+
+
+@pytest.mark.unit
+def test_anomaly_detector_warmup_and_min_window():
+    det = SlowStepDetector(factor=3.0, window=8, warmup=1, min_steps=8)
+    # the first (compiling) step is 100x steady state: warmup skips it
+    assert det.update(0, 10.0) is None
+    # fewer than min_steps in the window: never fires, whatever the value
+    for i in range(1, 8):
+        assert det.update(i, 50.0 if i == 5 else 0.1) is None
+
+
+# ---------------------------------------------------------------------------
+# telemetry accounting + exporter
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.unit
+def test_breakdown_components_sum_to_total():
+    tele = TrainTelemetry()
+    rng = np.random.default_rng(1)
+    expect_total = 0.0
+    for i in range(32):
+        dw, h, dev = rng.uniform(0.001, 0.05, size=3)
+        expect_total += dw + h + dev
+        tele.observe_step(i, data_wait_s=dw, host_s=h, device_s=dev,
+                          examples=16, real_tokens=500, total_tokens=512)
+    assert tele.m_step.count == 32
+    parts = (tele.m_data_wait.sum + tele.m_host.sum + tele.m_device.sum)
+    assert tele.m_step.sum == pytest.approx(parts, rel=1e-9)
+    assert tele.m_step.sum == pytest.approx(expect_total, rel=1e-9)
+    assert tele.m_padding_waste.value == pytest.approx(
+        100.0 * (1.0 - 500 / 512))
+    summary = tele.breakdown_summary()
+    assert summary["slow_step_anomalies"] == 0
+    assert summary["step_p50_s"] > 0
+    assert summary["device_p95_s"] > 0
+
+
+@pytest.mark.unit
+def test_loss_scale_adjustment_counting():
+    tele = TrainTelemetry()
+    for scale in (32768.0, 32768.0, 16384.0, 16384.0, 32768.0):
+        tele.observe_scalars({"loss": 1.0, "lr": 1e-4, "loss_scale": scale})
+    assert tele.m_loss_scale_adjustments.value == 2  # halve + re-double
+    assert tele.m_loss_scale.value == 32768.0
+
+
+def test_exporter_e2e_scrape(tmp_path):
+    """A live scrape sees every registered training metric, /healthz
+    answers, and pre-render hooks run before the render (the supervisor
+    sidecar counts update per scrape)."""
+    from ml_recipe_tpu.resilience.supervisor import write_supervisor_state
+
+    sidecar = tmp_path / "supervisor_state.json"
+    write_supervisor_state(sidecar, {
+        "attempts": 3, "restarts_used": 2,
+        "outcomes": ["crash", "preempted", "hang"],
+    })
+    tele = TrainTelemetry(supervisor_state_path=sidecar)
+    tele.observe_step(5, data_wait_s=0.01, host_s=0.02, device_s=0.1,
+                      examples=8, real_tokens=100, total_tokens=128)
+    exporter = MetricsExporter(
+        tele.registry, port=0, host="127.0.0.1",
+        health_fn=lambda: {"status": "ok", "global_step": 5},
+    ).start()
+    exporter.add_pre_render(tele.refresh)
+    try:
+        url = f"http://127.0.0.1:{exporter.port}"
+        with urllib.request.urlopen(f"{url}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        for name in tele.registry.names():
+            assert name in text, name
+        # sidecar counts arrived through the pre-render hook
+        assert "train_supervisor_restarts 2" in text
+        assert "train_supervisor_attempts 3" in text
+        assert "train_supervisor_exits_hang 1" in text
+        with urllib.request.urlopen(f"{url}/healthz", timeout=10) as r:
+            health = json.loads(r.read())
+        assert health == {"status": "ok", "global_step": 5}
+    finally:
+        exporter.close()
+
+
+# ---------------------------------------------------------------------------
+# supervisor sidecar
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.unit
+def test_supervisor_persists_observable_state(tmp_path):
+    from ml_recipe_tpu.resilience.supervisor import (
+        PREEMPT_EXIT_CODE,
+        RetryPolicy,
+        Supervisor,
+        peek_supervisor_state,
+    )
+
+    sidecar = tmp_path / "supervisor_state.json"
+    steps = iter([None, 10, 10, 20])  # before/after attempt 1, 2
+    codes = iter([PREEMPT_EXIT_CODE, 0])
+    seen = []
+
+    def launch(i):
+        # the sidecar must already exist (status=running) when the child —
+        # whose exporter reads it — comes up
+        seen.append(peek_supervisor_state(sidecar))
+        return next(codes)
+
+    result = Supervisor(
+        launch,
+        progress=lambda: next(steps),
+        policy=RetryPolicy(max_restarts=3, backoff_base=0.0),
+        sleep=lambda s: None,
+        state_path=sidecar,
+    ).run()
+    assert result.status == "clean"
+    assert seen[0]["status"] == "running" and seen[0]["attempts"] == 0
+    assert seen[1]["attempts"] == 1
+    assert seen[1]["outcomes"] == ["preempted"]
+
+    final = peek_supervisor_state(sidecar)
+    assert final["status"] == "clean"
+    assert final["attempts"] == 2
+    assert final["outcomes"] == ["preempted", "clean"]
+    assert final["restarts_used"] == 0  # the preemption made progress
+    assert final["step"] == 20
+    assert "updated_at" in final
+
+
+@pytest.mark.unit
+def test_peek_supervisor_state_tolerates_garbage(tmp_path):
+    from ml_recipe_tpu.resilience.supervisor import peek_supervisor_state
+
+    assert peek_supervisor_state(tmp_path / "missing.json") is None
+    bad = tmp_path / "bad.json"
+    bad.write_text("{ torn writ")
+    assert peek_supervisor_state(bad) is None
+    notdict = tmp_path / "list.json"
+    notdict.write_text("[1, 2]")
+    assert peek_supervisor_state(notdict) is None
+
+
+# ---------------------------------------------------------------------------
+# watchdog heartbeat
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.unit
+def test_watchdog_heartbeat_age():
+    from ml_recipe_tpu.resilience.watchdog import Watchdog
+
+    wd = Watchdog(timeout=30.0)
+    try:
+        assert wd.heartbeat_age() is None  # nothing armed yet
+        with wd.watch("step frame") as tick:
+            assert wd.heartbeat_age() < 1.0
+            tick("step 1")
+            assert wd.heartbeat_age() < 1.0
+        wd.note_progress(1)
+        assert wd.heartbeat_age() < 1.0
+    finally:
+        wd.stop()
+
+
+# ---------------------------------------------------------------------------
+# StepTimer satellite: only ImportError is survivable
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.unit
+def test_steptimer_propagates_non_import_errors(monkeypatch):
+    from ml_recipe_tpu.utils import profiler
+
+    class _BrokenJax:
+        @staticmethod
+        def block_until_ready(result):
+            raise ValueError("typo'd result tree")
+
+    monkeypatch.setitem(__import__("sys").modules, "jax", _BrokenJax())
+    timer = profiler.StepTimer()
+    timer.start()
+    with pytest.raises(ValueError, match="typo'd result tree"):
+        timer.stop(object())
+
+
+@pytest.mark.unit
+def test_steptimer_warns_once_without_jax(monkeypatch, caplog):
+    import sys
+
+    from ml_recipe_tpu.utils import profiler
+
+    # sys.modules[name] = None makes `import jax` raise ImportError
+    monkeypatch.setitem(sys.modules, "jax", None)
+    monkeypatch.setattr(profiler.StepTimer, "_warned_no_jax", False)
+    timer = profiler.StepTimer()
+    with caplog.at_level(logging.WARNING, logger="ml_recipe_tpu.utils.profiler"):
+        for _ in range(3):
+            timer.start()
+            timer.stop(object())
+    warnings = [r for r in caplog.records if "dispatch only" in r.message]
+    assert len(warnings) == 1  # warn once, then stay quiet
+
+
+# ---------------------------------------------------------------------------
+# trainer end to end: breakdown + spans + off == bit-identical
+# ---------------------------------------------------------------------------
+
+
+def test_trainer_breakdown_and_trace_spans(tmp_path, tracer):
+    """Instrumented tiny run: the telemetry surface fills with exactly one
+    observation per step, components partition the step wall, checkpoint
+    timings land, and the span stream is valid Chrome trace JSON covering
+    the training step window."""
+    tele = TrainTelemetry(anomaly_window=16)
+    trainer, _ = _make_trainer(
+        tmp_path, dropout=0.0, telemetry=tele, device_prefetch=0)
+    trainer.train()
+    steps = trainer.global_step
+    assert steps == 2  # train_len 32 / global batch 16
+
+    assert tele.m_steps.value == steps
+    assert tele.m_step.count == steps
+    assert tele.m_data_wait.count == steps
+    assert tele.m_host.count == steps
+    assert tele.m_device.count == steps
+    assert tele.m_step.sum == pytest.approx(
+        tele.m_data_wait.sum + tele.m_host.sum + tele.m_device.sum,
+        rel=1e-9,
+    )
+    assert tele.m_device.sum > 0  # the block-until-ready leg is real time
+    assert tele.m_global_step.value == steps - 1  # last observed step id
+    assert tele.m_lr.value > 0  # scalars tapped from the host fetch
+    # attention_mask accounting flowed through the place() wrapper
+    assert tele.m_tokens_per_sec.value > 0
+    assert 0.0 <= tele.m_padding_waste.value <= 100.0
+
+    trainer.save_state_dict(tmp_path / "obs.ch")
+    trainer.load_state_dict(tmp_path / "obs.ch")
+    assert tele.m_ckpt_save.count == 1
+    assert tele.m_ckpt_restore.count == 1
+
+    events = _validate_chrome_trace(tracer.close())
+    names = {e["name"] for e in events}
+    assert {"data_wait", "place", "step", "checkpoint_save",
+            "checkpoint_restore"} <= names
+    step_events = [e for e in events if e["name"] == "step"]
+    assert len(step_events) == steps
+    assert {e["args"]["step"] for e in step_events} == set(range(steps))
+
+
+def test_trainer_prefetch_instrumentation(tmp_path):
+    """With the prefetch thread on, host placement stats still arrive
+    (FIFO-matched across the queue) but are EXCLUDED from the step-wall
+    total: placement overlaps the previous step's device compute, so
+    counting it would overstate the wall (a prefetch thread falling
+    behind surfaces as data wait instead)."""
+    tele = TrainTelemetry()
+    trainer, _ = _make_trainer(
+        tmp_path, dropout=0.0, telemetry=tele, device_prefetch=2)
+    trainer.train()
+    assert tele.m_steps.value == trainer.global_step == 2
+    assert tele.m_host.count == 2
+    assert tele.m_host.sum > 0  # recorded on the prefetch thread
+    # total = data_wait + device only (host overlapped); note the first
+    # (preflight) step runs inline before the prefetcher exists, so its
+    # host leg IS on the wall and in the total
+    assert tele.m_step.sum < (
+        tele.m_data_wait.sum + tele.m_host.sum + tele.m_device.sum)
+    assert tele.m_step.sum >= tele.m_data_wait.sum + tele.m_device.sum
+
+
+def test_observability_off_is_bit_identical(tmp_path):
+    """Acceptance pin: the instrumented trajectory (telemetry + tracer,
+    blocking per step) equals the untouched off-path trajectory bit for
+    bit — observability must never perturb training arithmetic."""
+    (tmp_path / "off").mkdir()
+    (tmp_path / "on").mkdir()
+    t_off, _ = _make_trainer(tmp_path / "off", dropout=0.1)
+    t_off.train()
+    base = _param_snapshot(t_off.params)
+
+    tracer = trace_mod.install(
+        TraceWriter(str(tmp_path / "on" / "trace.json")))
+    try:
+        t_on, _ = _make_trainer(
+            tmp_path / "on", dropout=0.1, telemetry=TrainTelemetry())
+        t_on.train()
+    finally:
+        trace_mod.install(None)
+        tracer.close()
+    instrumented = _param_snapshot(t_on.params)
+
+    flat_a, _ = jax.tree_util.tree_flatten(base)
+    flat_b, _ = jax.tree_util.tree_flatten(instrumented)
+    assert len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# serving plane: request-lifecycle spans
+# ---------------------------------------------------------------------------
+
+
+def test_serving_request_lifecycle_spans(tmp_path, tracer):
+    """One request through engine + HTTP front end leaves the full span
+    chain — admission, queue, flush, device, span_reduce, respond — keyed
+    by its request id, in valid Chrome trace JSON."""
+    from ml_recipe_tpu.models import EncoderConfig, QAModel
+    from ml_recipe_tpu.parallel import build_mesh
+    from ml_recipe_tpu.serve.bucketing import BucketGrid
+    from ml_recipe_tpu.serve.engine import QAEngine
+    from ml_recipe_tpu.serve.server import QAServer
+
+    tok = make_tokenizer(tmp_path)
+    cfg = EncoderConfig(
+        vocab_size=len(tok), hidden_size=16, num_layers=1, num_heads=2,
+        intermediate_size=32, max_position_embeddings=66, num_labels=5,
+    )
+    model = QAModel(cfg)
+    params = model.init(
+        jax.random.key(0), np.zeros((1, 8), dtype=np.int32))["params"]
+    engine = QAEngine(
+        model, params, tok,
+        grid=BucketGrid.from_spec("4x64"),
+        mesh=build_mesh(),
+        max_batch_delay_ms=5,
+        queue_size=16,
+        max_question_len=16,
+        doc_stride=24,
+    )
+    engine.warmup(hbm_preflight=False)
+    server = QAServer(engine, port=0, request_timeout_s=60)
+    server.start()
+    try:
+        body = json.dumps({
+            "question": "what is the capital of england ?",
+            "document": "<P> London is the capital of England . </P>",
+        }).encode()
+        req = urllib.request.Request(
+            f"http://{server.host}:{server.port}/v1/qa", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 200
+    finally:
+        server.stop()
+        server.shutdown()
+
+    events = _validate_chrome_trace(tracer.close())
+    by_name = {}
+    for e in events:
+        by_name.setdefault(e["name"], []).append(e)
+    for name in ("admission", "queue", "flush", "device", "span_reduce",
+                 "respond"):
+        assert name in by_name, name
+    rid = by_name["admission"][-1]["args"]["request_id"]
+    assert any(e["args"]["request_id"] == rid for e in by_name["queue"])
+    assert any(e["args"]["request_id"] == rid
+               for e in by_name["span_reduce"])
+    assert any(e["args"]["request_id"] == rid for e in by_name["respond"])
+    assert all(e["cat"] == "serve" for e in by_name["device"])

@@ -612,24 +612,39 @@ def test_no_estimate_verdict_persisted_once(stack, monkeypatch):
 
 
 def test_metrics_surface_consistent_with_docs(stack):
-    """CI satellite: every metric registered in serve/metrics.py — cache
-    series included — must render in /metrics output AND appear in the
-    README metrics table, so the Prometheus surface cannot silently drift
-    from the docs."""
+    """CI satellite, shared by BOTH planes: every metric registered in the
+    serving engine's registry AND the training telemetry registry must
+    render in its /metrics output AND appear in the README metrics tables,
+    so neither Prometheus surface can silently drift from the docs."""
     engine = stack.cached
     names = engine.metrics.names()
     assert len(names) >= 28  # the full serving surface, cache series included
     for prefix in ("qa_doc_cache", "qa_chunk_cache", "qa_chunk_flight"):
         assert any(n.startswith(prefix) for n in names), prefix
 
-    rendered = engine.render_metrics()
     readme = (_REPO / "README.md").read_text()
+    rendered = engine.render_metrics()
     missing_render = [n for n in names if n not in rendered]
     missing_docs = [n for n in names if n not in readme]
+
+    # training plane rides the same gate (observability plane): the
+    # --metrics_port registry's names, rendered by the exporter
+    from ml_recipe_tpu.train.telemetry import TrainTelemetry
+
+    telemetry = TrainTelemetry()
+    telemetry.refresh()
+    train_names = telemetry.registry.names()
+    assert len(train_names) >= 20  # the full training surface
+    for prefix in ("train_step_", "train_supervisor_", "train_watchdog_"):
+        assert any(n.startswith(prefix) for n in train_names), prefix
+    rendered_train = telemetry.registry.render()
+    missing_render += [n for n in train_names if n not in rendered_train]
+    missing_docs += [n for n in train_names if n not in readme]
+
     assert not missing_render, (
         f"registered metrics absent from /metrics output: {missing_render}")
     assert not missing_docs, (
-        f"registered metrics absent from the README metrics table "
+        f"registered metrics absent from the README metrics tables "
         f"(document them): {missing_docs}")
 
 
