@@ -293,6 +293,7 @@ def bench_input(args) -> None:
     from ml_recipe_tpu.data.loader import DataLoader, ShardedBatchSampler
     from ml_recipe_tpu.data.packing import (
         PackedDataLoader,
+        parse_pack_splitting,
         parse_sequence_packing,
     )
     from ml_recipe_tpu.tokenizer import Tokenizer
@@ -423,6 +424,52 @@ def bench_input(args) -> None:
                 "pack_max_segments": getattr(args, "pack_max_segments", 8),
             }
 
+        # pass 4: splitting packer (--pack_splitting fill) — the same
+        # packed loader with hole-filling chunk fragments, reported as
+        # before/after so the splitter's win over the non-splitting floor
+        # is a number on every input-mode line
+        split_fields = {}
+        splitting = parse_pack_splitting(
+            getattr(args, "pack_splitting", "fill")
+        )
+        if packed_fields and splitting != "off":
+            min_fragment = int(getattr(args, "pack_min_fragment", 32))
+            sloader = PackedDataLoader(
+                make_dataset(), make_sampler(), tokenizer,
+                max_seq_len=L, rows_per_batch=B,
+                max_segments=getattr(args, "pack_max_segments", 8),
+                splitting=splitting, min_fragment=min_fragment,
+                n_jobs=args.infer_jobs,
+            )
+            sloader.set_epoch(1)
+            t0 = time.perf_counter()
+            for _batch in sloader:
+                pass
+            split_s = time.perf_counter() - t0
+            sstats = sloader.epoch_stats
+            swaste = sstats.get("padding_waste_pct")
+            pwaste_before = packed_fields.get("padding_waste_pct_packed")
+            split_fields = {
+                "pack_splitting": splitting,
+                "pack_min_fragment": min_fragment,
+                "padding_waste_pct_split": swaste,
+                "packing_efficiency_split": sstats.get("packing_efficiency"),
+                "waste_before_split_pct": pwaste_before,
+                "waste_after_split_pct": swaste,
+                "split_count": sstats.get("split_count"),
+                "fragment_rows": sstats.get("fragment_rows"),
+                "fragment_size_hist": sstats.get("fragment_size_hist"),
+                "batches_split": sstats["batches"],
+                "rows_per_sec_split": round(sstats["rows"] / split_s, 1),
+                "nonpad_tokens_per_sec_split": round(
+                    sstats["real_tokens"] / split_s, 1
+                ),
+                "waste_reduction_x_split": (
+                    round(pwaste_before / swaste, 2)
+                    if pwaste_before is not None and swaste else None
+                ),
+            }
+
         headline = bucket_fields.get(
             "nonpad_tokens_per_sec", round(real_tokens / padmax_s, 1)
         )
@@ -446,6 +493,7 @@ def bench_input(args) -> None:
                     "seq_len": L,
                     **bucket_fields,
                     **packed_fields,
+                    **split_fields,
                 }
             )
         )
@@ -1096,6 +1144,14 @@ def main() -> None:
                              "padding_waste_pct_packed ('off' skips it)")
     parser.add_argument("--pack_max_segments", type=int, default=8,
                         help="input mode: max chunks per packed row")
+    parser.add_argument("--pack_splitting", type=str, default="fill",
+                        help="input mode: run the splitting-packer pass "
+                             "(hole-filling chunk fragments) and report "
+                             "splitter stats + waste before/after ('off' "
+                             "skips it)")
+    parser.add_argument("--pack_min_fragment", type=int, default=32,
+                        help="input mode: splitting packer's minimum "
+                             "fragment size in tokens")
     # --mode converge knobs (VERDICT r2 #1b). Defaults are the proven
     # from-scratch bert-base recipe (measured on a v5e chip: loss 8.61 ->
     # 0.0006, mAP 0.21 -> 1.00 in 2520 steps / ~9 min): post-LN depth

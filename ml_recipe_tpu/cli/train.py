@@ -226,6 +226,8 @@ def _run_instrumented(params, model_params, watchdog, local_logger, mesh,
         ),
         sequence_packing=getattr(params, "sequence_packing", False),
         pack_max_segments=getattr(params, "pack_max_segments", 8),
+        pack_splitting=getattr(params, "pack_splitting", "off"),
+        pack_min_fragment=getattr(params, "pack_min_fragment", 32),
         device_prefetch=getattr(params, "device_prefetch", 0),
         log_every=getattr(params, "log_every", 10),
         telemetry=telemetry,

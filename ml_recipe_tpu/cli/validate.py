@@ -58,6 +58,8 @@ def main(params, model_params):
         ),
         sequence_packing=getattr(params, "sequence_packing", False),
         pack_max_segments=getattr(params, "pack_max_segments", 8),
+        pack_splitting=getattr(params, "pack_splitting", "off"),
+        pack_min_fragment=getattr(params, "pack_min_fragment", 32),
     )
 
     predictor(val_dataset)

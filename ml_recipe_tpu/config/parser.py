@@ -456,6 +456,22 @@ def get_trainer_parser() -> ConfigArgumentParser:
                         help="Sequence packing: max chunks packed into one "
                              "row (the static S of the per-segment label "
                              "planes and head outputs).")
+    parser.add_argument("--pack_splitting", type=str, default="off",
+                        help="Hole-filling chunk splitting for the packer: "
+                             "'off' (default — the non-splitting packer, "
+                             "bit-identical to before) or 'fill' (a chunk "
+                             "that fits no open row is split at a "
+                             "label-safe token boundary — never through "
+                             "the gold answer span — and its head "
+                             "fragment fills the largest residual hole; "
+                             "the span-bearing fragment keeps the labels, "
+                             "siblings are ignore-indexed). Breaks the "
+                             "~1.6%% waste floor of quantized chunk mixes.")
+    parser.add_argument("--pack_min_fragment", type=int, default=32,
+                        help="Splitting packer: minimum fragment size in "
+                             "tokens (no head or tail fragment goes below "
+                             "this — avoids degenerate few-token "
+                             "segments).")
     parser.add_argument("--device_prefetch", type=cast_prefetch, default=0,
                         help="Double-buffered device prefetch depth: keep "
                              "this many placed global batches in flight on "
@@ -670,6 +686,14 @@ def get_predictor_parser() -> ConfigArgumentParser:
                              "trainer flag).")
     parser.add_argument("--pack_max_segments", type=int, default=8,
                         help="Sequence packing: max chunks per packed row.")
+    parser.add_argument("--pack_splitting", type=str, default="off",
+                        help="Hole-filling chunk splitting for packed "
+                             "offline eval ('off'|'fill'); fragment span "
+                             "logits re-merge to per-chunk outputs before "
+                             "the span reduction.")
+    parser.add_argument("--pack_min_fragment", type=int, default=32,
+                        help="Splitting packer: minimum fragment size in "
+                             "tokens.")
 
     parser.add_argument("--quantize", type=str, default="off",
                         choices=["off", "int8"],

@@ -36,7 +36,7 @@ from __future__ import annotations
 import logging
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, List, NamedTuple, Optional, Sequence
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence
 
 import numpy as np
 
@@ -231,7 +231,10 @@ class BucketedDataLoader:
         self._epoch = 0
         self._collates: Dict[int, object] = {}
         self._last_stats: Optional[dict] = None
-        self._len_cache: Dict[int, int] = {}
+        # planning-meta cache shared with data/packing's planners:
+        # (length, start_id, end_id) tuples (the bucketer reads only
+        # the length column), keyed by index or (epoch_key, index)
+        self._len_cache: Dict[Any, tuple] = {}
         self.rescale(batch_multiple)
 
     def rescale(self, batch_multiple: int) -> Dict[int, int]:

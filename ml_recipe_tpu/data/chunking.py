@@ -264,6 +264,47 @@ def assemble_input_ids(
     return [cls_id, *encoded_question, sep_id, *rec.token_ids, sep_id]
 
 
+def label_safe_cut(
+    length: int,
+    span: Optional[Tuple[int, int]],
+    hole: int,
+    min_fragment: int,
+) -> Optional[int]:
+    """Token-boundary cut point for splitting a ``length``-token chunk so
+    its head fragment fills a ``hole``-token residual gap of an open pack
+    row (data/packing.py's splitting packer), or ``None`` when no legal cut
+    exists.
+
+    A cut at ``c`` makes fragments ``[0, c)`` and ``[c, length)``. Legal
+    means: both fragments are at least ``min_fragment`` tokens (no
+    degenerate one-token segments), the head fits the hole (``c <= hole``),
+    and the cut NEVER lands strictly inside the gold answer span ``span``
+    (inclusive ``(start, end)`` token indices into the chunk) — a bisected
+    span would leave NO fragment containing the whole answer, so neither
+    could carry the labels. The nominal cut is the hole-filling maximum
+    ``min(hole, length - min_fragment)``; when that would bisect the span,
+    the cut retreats to the span start (the span moves wholly into the
+    tail — the nominal cut is already the LARGEST legal cut, so past the
+    span end is never an option), and when even that violates the
+    min_fragment floor there is no legal cut. Pure arithmetic over
+    ``(length, span, hole)`` — the property that lets every host derive
+    identical split plans from the shared length oracle.
+    """
+    min_fragment = max(1, int(min_fragment))
+    cut = min(int(hole), int(length) - min_fragment)
+    if cut < min_fragment:
+        return None
+    if span is not None:
+        start, end = int(span[0]), int(span[1])
+        if 0 <= start <= end < length and start < cut <= end:
+            # nominal cut bisects the span: retreat to its start so the
+            # whole span lands in the tail fragment
+            if start < min_fragment:
+                return None
+            cut = start
+    return cut
+
+
 def chunk_sampling_weights(records: Sequence[ChunkRecord]):
     import numpy as np
 

@@ -14,6 +14,11 @@ The pieces:
 - :class:`SequencePacker` — greedy first-fit binning of tokenized chunks
   into rows, walking the SAME deterministic weighted/shuffled epoch order
   the samplers draw (packing changes row composition, never item order);
+  ``splitting='fill'`` (``--pack_splitting``) additionally splits a chunk
+  that fits no open row at a label-safe token boundary
+  (chunking.label_safe_cut) and drops the head :class:`ChunkFragment` into
+  the largest residual hole — the only path below the ~1.6% waste floor
+  that quantized chunk-length mixes impose on ANY non-splitting packer;
 - :func:`collate_packed` — one packed batch: ``input_ids`` /
   ``attention_mask`` / ``token_type_ids`` planes plus ``segment_ids``
   (1..S per segment, 0 on pad — also the attention kernels' block-diagonal
@@ -46,14 +51,16 @@ function of (seed, lengths).
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import threading
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, List, NamedTuple, Optional, Sequence
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .chunking import label_safe_cut
 from .loader import _read_with_retry
 
 logger = logging.getLogger(__name__)
@@ -101,16 +108,39 @@ def _oracle_epoch_key(dataset, epoch: int) -> int:
     return int(epoch) if getattr(dataset, "rng", None) is not None else 0
 
 
-def oracle_epoch_lengths(dataset, indices, *, cache: Dict[tuple, int],
-                         n_jobs: int, read_retries: int,
-                         epoch: int = 0) -> List[int]:
-    """Item lengths for ``indices`` under the shared oracle, reading each
-    UNIQUE ``(epoch, index)`` at most once (``cache`` persists across
-    epochs and is EXACT here — oracle reads are reproducible, unlike the
-    planning-only estimates of :func:`epoch_item_lengths`).
+def _item_meta(item) -> Tuple[int, int, int]:
+    """The cached planning meta of one item: ``(length, start_id, end_id)``
+    — everything the pack/bucket planners (including every split decision
+    of the splitting packer, which must steer cuts around the gold span)
+    need, without holding the item itself."""
+    return (
+        len(item.input_ids),
+        int(getattr(item, "start_id", -1)),
+        int(getattr(item, "end_id", -1)),
+    )
+
+
+def _meta_span(meta) -> Optional[Tuple[int, int]]:
+    """Span tuple of one cached meta (see :func:`_item_span`)."""
+    _length, start, end = meta
+    if start < 0 or end < start:
+        return None
+    return start, end
+
+
+def oracle_epoch_meta(dataset, indices, *, cache: Dict[tuple, tuple],
+                      n_jobs: int, read_retries: int,
+                      epoch: int = 0) -> List[tuple]:
+    """Item metas ``(length, start_id, end_id)`` for ``indices`` under the
+    shared oracle, reading each UNIQUE ``(epoch, index)`` at most once
+    (``cache`` persists across epochs and is EXACT here — oracle reads are
+    reproducible, unlike the planning-only estimates of
+    :func:`epoch_item_lengths`). The span rides along because the splitting
+    packer's cut points are span-dependent: a length-only plan could not
+    agree across hosts on WHERE a chunk splits.
 
     Cost model: deterministic (rng-less) corpora read fully parallel and
-    their lengths are cached ONCE for the whole run; stochastic-chunk
+    their metas are cached ONCE for the whole run; stochastic-chunk
     (rng-carrying) datasets re-draw per epoch AND serialize on the oracle
     lock (``dataset.rng`` is shared mutable state — there is no parallel
     read under a pinned generator), so every host pays one serial
@@ -130,8 +160,22 @@ def oracle_epoch_lengths(dataset, indices, *, cache: Dict[tuple, int],
                     missing,
                 ),
             ):
-                cache[(ek, idx)] = len(item.input_ids)
+                cache[(ek, idx)] = _item_meta(item)
     return [cache[(ek, int(i))] for i in indices]
+
+
+def oracle_epoch_lengths(dataset, indices, *, cache: Dict[tuple, tuple],
+                         n_jobs: int, read_retries: int,
+                         epoch: int = 0) -> List[int]:
+    """Item lengths under the shared oracle — :func:`oracle_epoch_meta`
+    with only the length column (what the bucket planner consumes)."""
+    return [
+        meta[0]
+        for meta in oracle_epoch_meta(
+            dataset, indices, cache=cache, n_jobs=n_jobs,
+            read_retries=read_retries, epoch=epoch,
+        )
+    ]
 
 # Per-row segment cap: keeps the per-segment label planes ([rows, S]) and
 # the model's per-segment head outputs at one static shape. 8 comfortably
@@ -161,6 +205,78 @@ def parse_sequence_packing(spec) -> bool:
     return s not in ("off", "none", "0", "false", "")
 
 
+# Minimum fragment size of the splitting packer (--pack_min_fragment): no
+# fragment — head or tail — goes below this many tokens, so splitting never
+# manufactures degenerate few-token segments (which would burn a segment
+# slot and a pooled-head row for ~no context). 32 clears the synthetic NQ
+# mix's ~49-token holes while keeping every fragment a meaningful window.
+DEFAULT_MIN_FRAGMENT = 32
+
+
+def parse_pack_splitting(spec) -> str:
+    """Flag domain of ``--pack_splitting``: ``off`` (default — the
+    non-splitting packer, bit-identical to the pre-splitting code path) or
+    ``fill`` (split pending chunks at label-safe token boundaries to fill
+    residual holes). Truthy bools/strings alias ``fill``."""
+    if spec is None or spec is False:
+        return "off"
+    if spec is True:
+        return "fill"
+    s = str(spec).strip().lower()
+    if s in ("off", "none", "0", "false", ""):
+        return "off"
+    if s in ("fill", "on", "1", "true", "yes"):
+        return "fill"
+    raise ValueError(f"--pack_splitting must be off|fill, got {spec!r}")
+
+
+@dataclasses.dataclass
+class ChunkFragment:
+    """One fragment of a split chunk, carried through pack rows in place of
+    the whole item. ``item`` is whatever payload the packer was given (a
+    DatasetItem/ChunkItem on the live path, an ``(index, length)`` pair on
+    the oracle plan, ``None`` in step simulations); ``offset``/``length``
+    slice the parent chunk's token stream, ``(chunk_id, index, count)`` are
+    the re-merge provenance (``count`` is stamped once the whole chunk is
+    placed), and ``keep_labels`` marks the ONE fragment that carries the
+    parent's labels — the one containing the gold span (the head for
+    spanless chunks); siblings collate with ``segment_mask`` 0, which the
+    packed loss rewrites to each head's ignore-index, so a split chunk is
+    never double-counted."""
+
+    item: Any
+    chunk_id: int
+    offset: int
+    length: int
+    index: int
+    count: int = 0
+    keep_labels: bool = False
+    chunk_len: int = 0
+
+
+def _entry_tokens(entry) -> int:
+    """Token count of one pack-row entry (whole item or fragment)."""
+    if isinstance(entry, ChunkFragment):
+        return entry.length
+    return len(entry.input_ids)
+
+
+def _entry_is_example(entry) -> bool:
+    """Does this entry count as a real example (label carrier)? Whole items
+    always; of a split chunk, only the ``keep_labels`` fragment."""
+    return entry.keep_labels if isinstance(entry, ChunkFragment) else True
+
+
+def _item_span(item) -> Optional[Tuple[int, int]]:
+    """Gold-span token indices of an item (inclusive, into its input_ids),
+    or None when spanless/unknown — what label-safe cuts steer around."""
+    start = int(getattr(item, "start_id", -1))
+    end = int(getattr(item, "end_id", -1))
+    if start < 0 or end < start:
+        return None
+    return start, end
+
+
 # LR-schedule planning reads item LENGTHS, which means materializing items
 # (chunk assembly + tokenization). Bound that pre-training pass: past this
 # many items the planners simulate on the epoch ordering's prefix and scale
@@ -169,25 +285,26 @@ def parse_sequence_packing(spec) -> bool:
 PLAN_SAMPLE_ITEMS = 4096
 
 
-def epoch_item_lengths(dataset, sampler, epoch, *, cache: Dict[int, int],
-                       n_jobs: int, read_retries: int,
-                       max_items: Optional[int] = None,
-                       oracle: bool = False) -> List[int]:
-    """Item lengths in one epoch's order (truncated to ``max_items`` when
-    given), reading each UNIQUE index at most once (``cache`` persists
-    across epochs — for stochastic-chunk datasets the cached length is one
-    draw, an estimate by construction). The dataset's chunk-sampling RNG,
-    when it has one, is swapped for a throwaway during the reads so
-    PLANNING never perturbs the training draw stream. Shared by the packed
-    and bucketed loaders' LR-schedule step planning. ``oracle=True``
-    switches the reads to the shared length oracle (per-index pinned RNG):
-    exact and host-invariant — what multi-host planning must use, since a
-    host-divergent step estimate would diverge the LR schedule itself."""
+def epoch_item_meta(dataset, sampler, epoch, *, cache: Dict[int, tuple],
+                    n_jobs: int, read_retries: int,
+                    max_items: Optional[int] = None,
+                    oracle: bool = False) -> List[tuple]:
+    """Item metas ``(length, start_id, end_id)`` in one epoch's order
+    (truncated to ``max_items`` when given), reading each UNIQUE index at
+    most once (``cache`` persists across epochs — for stochastic-chunk
+    datasets the cached meta is one draw, an estimate by construction). The
+    dataset's chunk-sampling RNG, when it has one, is swapped for a
+    throwaway during the reads so PLANNING never perturbs the training draw
+    stream. Shared by the packed and bucketed loaders' LR-schedule step
+    planning. ``oracle=True`` switches the reads to the shared length
+    oracle (per-index pinned RNG): exact and host-invariant — what
+    multi-host planning must use, since a host-divergent step estimate
+    would diverge the LR schedule itself."""
     indices = [int(i) for i in sampler.epoch_indices(epoch)]
     if max_items is not None:
         indices = indices[:max_items]
     if oracle:
-        return oracle_epoch_lengths(
+        return oracle_epoch_meta(
             dataset, indices, cache=cache, n_jobs=n_jobs,
             read_retries=read_retries, epoch=epoch,
         )
@@ -207,31 +324,49 @@ def epoch_item_lengths(dataset, sampler, epoch, *, cache: Dict[int, int],
                         missing,
                     ),
                 ):
-                    cache[idx] = len(item.input_ids)
+                    cache[idx] = _item_meta(item)
         finally:
             if saved_rng is not None:
                 dataset.rng = saved_rng
     return [cache[i] for i in indices]
 
 
-def plan_scaled_count(dataset, sampler, epoch, *, cache: Dict[int, int],
+def epoch_item_lengths(dataset, sampler, epoch, *, cache: Dict[int, tuple],
+                       n_jobs: int, read_retries: int,
+                       max_items: Optional[int] = None,
+                       oracle: bool = False) -> List[int]:
+    """Item lengths in one epoch's order — :func:`epoch_item_meta` with
+    only the length column."""
+    return [
+        meta[0]
+        for meta in epoch_item_meta(
+            dataset, sampler, epoch, cache=cache, n_jobs=n_jobs,
+            read_retries=read_retries, max_items=max_items, oracle=oracle,
+        )
+    ]
+
+
+def plan_scaled_count(dataset, sampler, epoch, *, cache: Dict[int, tuple],
                       n_jobs: int, read_retries: int, simulate,
-                      oracle: bool = False) -> int:
+                      oracle: bool = False, meta: bool = False) -> int:
     """Shared LR-schedule planning skeleton of the packed and bucketed
     loaders: read the epoch's item lengths (prefix-bounded by
     ``PLAN_SAMPLE_ITEMS``), run the loader-specific ``simulate(lengths) ->
     count``, and scale the count back to the full epoch when only a prefix
-    was read. Loader-specific tail handling (pad_last flushes, rows-per-
-    batch division) stays with the caller — it must NOT be prefix-scaled."""
+    was read. ``meta=True`` hands ``simulate`` the full ``(length,
+    start_id, end_id)`` metas instead — the splitting packer's simulation
+    needs the spans to replay its label-safe cut decisions exactly.
+    Loader-specific tail handling (pad_last flushes, rows-per-batch
+    division) stays with the caller — it must NOT be prefix-scaled."""
     n_total = len(sampler.epoch_indices(epoch))
-    lengths = epoch_item_lengths(
+    metas = epoch_item_meta(
         dataset, sampler, epoch, cache=cache, n_jobs=n_jobs,
         read_retries=read_retries, max_items=PLAN_SAMPLE_ITEMS,
         oracle=oracle,
     )
-    count = simulate(lengths)
-    if lengths and n_total > len(lengths):
-        count = int(round(count * n_total / len(lengths)))
+    count = simulate(metas if meta else [m[0] for m in metas])
+    if metas and n_total > len(metas):
+        count = int(round(count * n_total / len(metas)))
     return count
 
 
@@ -244,19 +379,42 @@ class SequencePacker:
     window 8 on the synthetic NQ mix vs emitting the oldest. Rows that
     fill exactly (or hit ``max_segments``) close eagerly. Pure function of
     the item sequence — deterministic under the deterministic epoch
-    orderings the samplers draw."""
+    orderings the samplers draw.
+
+    ``splitting='fill'`` adds the hole-filling pass that breaks the
+    non-splitting packer's ~1.6% floor on quantized length mixes: an item
+    that fits NO open row whole is split at a label-safe token boundary
+    (:func:`ml_recipe_tpu.data.chunking.label_safe_cut` — the cut never
+    bisects the gold ``span``), its head :class:`ChunkFragment` drops into
+    the open row with the LARGEST residual hole, and the tail re-enters the
+    same placement walk (it may fill another hole, split again, or open a
+    new row). Fragments are ordinary segments downstream; only the
+    span-bearing one carries labels. Still a pure function of the
+    ``(item, length, span)`` sequence, so simulations and every oracle
+    host replay the identical plan — and with ``splitting='off'`` (the
+    default) the code path is EXACTLY the pre-splitting packer."""
 
     def __init__(self, max_seq_len: int, *,
                  max_segments: int = DEFAULT_MAX_SEGMENTS,
-                 open_rows: int = DEFAULT_OPEN_ROWS):
+                 open_rows: int = DEFAULT_OPEN_ROWS,
+                 splitting: str = "off",
+                 min_fragment: int = DEFAULT_MIN_FRAGMENT):
         self.max_seq_len = int(max_seq_len)
         self.max_segments = max(1, int(max_segments))
         self.open_rows = max(1, int(open_rows))
+        self.splitting = parse_pack_splitting(splitting)
+        self.min_fragment = max(1, int(min_fragment))
+        self.split_count = 0  # cuts performed (fragments created - chunks)
         self._open: List[tuple] = []  # (items, used_tokens)
+        self._next_chunk_id = 0
+        self._placing: List[ChunkFragment] = []  # fragments of the in-flight add
 
-    def add(self, item, length: int) -> List[list]:
+    def add(self, item, length: int, span=None) -> List[list]:
         """Place one item; returns the (possibly empty) list of COMPLETED
-        rows this placement closed, each a list of items in row order."""
+        rows this placement closed, each a list of entries (items and/or
+        :class:`ChunkFragment`\\ s) in row order. ``span`` — the item's gold
+        answer ``(start, end)`` token indices (or None) — only steers the
+        splitting packer's cut points; the non-splitting path ignores it."""
         length = int(length)
         if length > self.max_seq_len:
             raise ValueError(
@@ -264,23 +422,121 @@ class SequencePacker:
                 f"{self.max_seq_len} (the collate would reject it too)"
             )
         done: List[list] = []
+        self._place(item, length, self._norm_span(span, length), done)
+        if self._placing:
+            # the chunk is now fully placed: stamp the final fragment count
+            # on every fragment (re-merge needs to know when a chunk is
+            # complete, and rows may be emitted out of placement order)
+            for frag in self._placing:
+                frag.count = len(self._placing)
+            self._placing = []
+        return done
+
+    @staticmethod
+    def _norm_span(span, length: int):
+        if span is None:
+            return None
+        start, end = int(span[0]), int(span[1])
+        if not (0 <= start <= end < length):
+            return None
+        return start, end
+
+    def _place(self, entry, length: int, span, done: List[list]) -> None:
+        """One placement step (whole-entry first-fit, then hole-filling
+        split, then forced-emit + new row) — with ``splitting='off'`` this
+        body is the historical ``add`` verbatim."""
         for i, (items, used) in enumerate(self._open):
             if used + length <= self.max_seq_len and len(items) < self.max_segments:
-                items.append(item)
+                items.append(entry)
                 used += length
                 if used == self.max_seq_len or len(items) == self.max_segments:
                     done.append(items)
                     del self._open[i]
                 else:
                     self._open[i] = (items, used)
-                return done
+                return
+        if (
+            self.splitting == "fill"
+            and length >= 2 * self.min_fragment
+            and self._split_place(entry, length, span, done)
+        ):
+            return
         if len(self._open) >= self.open_rows:
             fullest = max(
                 range(len(self._open)), key=lambda i: self._open[i][1]
             )
             done.append(self._open.pop(fullest)[0])
-        self._open.append(([item], length))
-        return done
+        self._open.append(([entry], length))
+
+    def _split_place(self, entry, length: int, span, done: List[list]) -> bool:
+        """Try to split ``entry`` so its head fragment fills an open row's
+        residual hole; rows are tried largest-hole-first (ties to the
+        oldest — determinism). Returns False when no row admits a legal
+        label-safe cut (caller falls through to the non-splitting path)."""
+        order = sorted(
+            range(len(self._open)),
+            key=lambda i: (self._open[i][1], i),
+        )
+        for i in order:
+            items, used = self._open[i]
+            hole = self.max_seq_len - used
+            if hole < self.min_fragment or len(items) >= self.max_segments:
+                continue
+            cut = label_safe_cut(length, span, hole, self.min_fragment)
+            if cut is None:
+                continue
+            head, tail, tail_span = self._cut(entry, length, span, cut)
+            items.append(head)
+            used += cut
+            self.split_count += 1
+            if used == self.max_seq_len or len(items) == self.max_segments:
+                done.append(items)
+                del self._open[i]
+            else:
+                self._open[i] = (items, used)
+            # the tail re-enters the full placement walk: it may fit a row
+            # whole, fill another hole (splitting again), or open a new row
+            self._place(tail, tail.length, tail_span, done)
+            return True
+        return False
+
+    def _cut(self, entry, length: int, span, cut: int):
+        """Split ``entry`` at ``cut`` into (head, tail) fragments plus the
+        tail-relative span. Labels follow the span: the fragment wholly
+        containing it keeps them (head for spanless chunks); re-splitting a
+        tail threads ``keep_labels`` through so exactly ONE fragment of the
+        chunk ever carries them."""
+        if isinstance(entry, ChunkFragment):
+            parent, chunk_id = entry.item, entry.chunk_id
+            base_offset, base_index = entry.offset, entry.index
+            carried, chunk_len = entry.keep_labels, entry.chunk_len
+            self._placing.remove(entry)
+        else:
+            parent, chunk_id = entry, self._next_chunk_id
+            self._next_chunk_id += 1
+            base_offset, base_index = 0, 0
+            carried, chunk_len = True, length
+        head_keeps = tail_keeps = False
+        tail_span = None
+        if carried:
+            if span is None:
+                head_keeps = True
+            elif span[1] < cut:
+                head_keeps = True
+            else:  # label_safe_cut guarantees span[0] >= cut here
+                tail_keeps = True
+                tail_span = (span[0] - cut, span[1] - cut)
+        head = ChunkFragment(
+            item=parent, chunk_id=chunk_id, offset=base_offset, length=cut,
+            index=base_index, keep_labels=head_keeps, chunk_len=chunk_len,
+        )
+        tail = ChunkFragment(
+            item=parent, chunk_id=chunk_id, offset=base_offset + cut,
+            length=length - cut, index=base_index + 1,
+            keep_labels=tail_keeps, chunk_len=chunk_len,
+        )
+        self._placing.extend([head, tail])
+        return head, tail, tail_span
 
     def flush(self) -> List[list]:
         """Emit every open row (epoch end), oldest first."""
@@ -293,39 +549,59 @@ class PackedBatch(NamedTuple):
     """One collated packed batch: ``rows`` rows of ``seq`` tokens holding
     ``segments`` real segments (= original examples); pad rows (eval tail
     padding) repeat the last real row with ``segment_mask`` zeroed, so
-    masked losses/metrics skip them without trimming."""
+    masked losses/metrics skip them without trimming. ``provenance`` (only
+    populated under ``--pack_splitting fill``) carries the per-segment
+    ``chunk_id`` / ``fragment_index`` / ``token_offset`` planes of the
+    splitting packer — host-side metadata, never fed to the model."""
 
     inputs: dict
     labels: dict
     rows: int
     segments: int
     seq: int
+    provenance: Optional[dict] = None
 
 
 def collate_packed(row_items: Sequence[list], tokenizer, *,
                    max_seq_len: int, max_segments: int = DEFAULT_MAX_SEGMENTS,
-                   with_labels: bool = True):
-    """Collate packed rows (lists of DatasetItem/ChunkItem) into the packed
-    batch schema.
+                   with_labels: bool = True, with_provenance: bool = False):
+    """Collate packed rows (lists of DatasetItem/ChunkItem and/or
+    :class:`ChunkFragment`) into the packed batch schema.
 
     Inputs (all ``[rows, L]`` int32 except ``segment_starts``):
       - ``input_ids``: concatenated chunk ids, pad_token_id elsewhere;
       - ``attention_mask``: 1 on real tokens (= ``segment_ids > 0``);
       - ``token_type_ids``: the plain collate's BERT rule applied WITHIN
-        each segment (0 through its first [SEP], 1 after);
+        each segment (0 through its first [SEP], 1 after); a fragment
+        inherits its PARENT chunk's token-type slice, so the planes of a
+        split chunk concatenate to exactly the unsplit chunk's;
       - ``segment_ids``: 1..S per segment, 0 on pad — the attention
-        kernels' block-diagonal mask operand;
+        kernels' block-diagonal mask operand (fragments are ordinary
+        segments under it);
       - ``position_ids``: 0..len(seg)-1 within each segment (position
-        embeddings reset at every boundary), 0 on pad;
-      - ``segment_starts`` ``[rows, S]``: each segment's [CLS] row index
-        (0 for absent segments — gathered rows are masked downstream).
+        embeddings reset at every boundary), 0 on pad; a FRAGMENT's
+        positions CONTINUE at its ``token_offset`` so every token keeps
+        the position embedding it had in the unsplit chunk;
+      - ``segment_starts`` ``[rows, S]``: each segment's first row index
+        (the [CLS] for whole chunks and head fragments; gathered rows of
+        absent segments are masked downstream).
 
     Labels (``[rows, S]``; ``with_labels=False`` skips them for pure
     inference): ``start_class``/``end_class`` are ROW-ABSOLUTE token
     indices (chunk-relative index + segment offset; -1 for spanless chunks
     AND absent segments — the span CE's ignore_index), ``start_reg``/
     ``end_reg``/``cls`` as in the plain collate, plus ``segment_mask``
-    (1 = real segment) which the packed loss keys every mean on.
+    (1 = real segment) which the packed loss keys every mean on. Of a
+    split chunk only the ``keep_labels`` fragment is a real segment — the
+    label-safe cut guarantees it contains the whole gold span (rebased by
+    its ``token_offset``); sibling fragments carry mask 0 and -1 spans, so
+    the packed loss ignore-indexes them and the chunk is counted once.
+
+    ``with_labels=False`` returns ``(inputs, segment_mask)`` where the
+    mask marks every PRESENT segment (fragments included — inference
+    consumers need all of them for the re-merge); ``with_provenance=True``
+    appends a third element: the ``chunk_id`` / ``fragment_index`` /
+    ``token_offset`` ``[rows, S]`` planes (-1/0/0 for whole chunks).
     """
     R, L, S = len(row_items), int(max_seq_len), int(max_segments)
     pad_id = tokenizer.pad_token_id
@@ -345,11 +621,24 @@ def collate_packed(row_items: Sequence[list], tokenizer, *,
     end_reg = np.zeros((R, S), dtype=np.float32)
     cls = np.zeros((R, S), dtype=np.int32)
 
+    if with_provenance:
+        chunk_id = np.full((R, S), -1, dtype=np.int32)
+        fragment_index = np.zeros((R, S), dtype=np.int32)
+        token_offset = np.zeros((R, S), dtype=np.int32)
+
     for r, items in enumerate(row_items):
         assert len(items) <= S, (len(items), S)
         off = 0
-        for s, item in enumerate(items):
-            row = item.input_ids
+        for s, entry in enumerate(items):
+            frag = entry if isinstance(entry, ChunkFragment) else None
+            item = frag.item if frag is not None else entry
+            parent_row = item.input_ids
+            if frag is not None:
+                row = parent_row[frag.offset:frag.offset + frag.length]
+                frag_off = frag.offset
+            else:
+                row = parent_row
+                frag_off = 0
             n = len(row)
             assert off + n <= L, (
                 f"packed row overflows max_seq_len {L} at segment {s} "
@@ -357,18 +646,34 @@ def collate_packed(row_items: Sequence[list], tokenizer, *,
             )
             input_ids[r, off:off + n] = row
             segment_ids[r, off:off + n] = s + 1
-            position_ids[r, off:off + n] = np.arange(n, dtype=np.int32)
+            position_ids[r, off:off + n] = frag_off + np.arange(
+                n, dtype=np.int32
+            )
             if is_bert:
                 # segment 0 up to and including the first [SEP] WITHIN this
-                # packed segment, 1 after (collate.py:42-51 semantics)
-                sep_pos = row.index(sep_id) if sep_id in row else n - 1
-                token_type_ids[r, off + sep_pos + 1:off + n] = 1
+                # packed segment, 1 after (collate.py:42-51 semantics);
+                # fragments slice the PARENT's plane so a split chunk's
+                # token types concatenate to the unsplit chunk's
+                sep_pos = (
+                    parent_row.index(sep_id) if sep_id in parent_row
+                    else len(parent_row) - 1
+                )
+                ones_from = max(sep_pos + 1 - frag_off, 0)
+                if ones_from < n:
+                    token_type_ids[r, off + ones_from:off + n] = 1
             segment_starts[r, s] = off
-            segment_mask[r, s] = 1
-            if with_labels:
+            is_example = frag is None or frag.keep_labels
+            segment_mask[r, s] = 1 if (is_example or not with_labels) else 0
+            if with_provenance:
+                chunk_id[r, s] = frag.chunk_id if frag is not None else -1
+                fragment_index[r, s] = frag.index if frag is not None else 0
+                token_offset[r, s] = frag_off
+            if with_labels and is_example:
                 if item.start_id >= 0:
-                    start_class[r, s] = item.start_id + off
-                    end_class[r, s] = item.end_id + off
+                    # the label-safe cut pins the whole span inside this
+                    # fragment, so the rebased indices stay in [0, n)
+                    start_class[r, s] = item.start_id - frag_off + off
+                    end_class[r, s] = item.end_id - frag_off + off
                 start_reg[r, s] = item.start_position
                 end_reg[r, s] = item.end_position
                 cls[r, s] = item.label_id
@@ -382,7 +687,17 @@ def collate_packed(row_items: Sequence[list], tokenizer, *,
         "position_ids": position_ids,
         "segment_starts": segment_starts,
     }
+    provenance = (
+        {
+            "chunk_id": chunk_id,
+            "fragment_index": fragment_index,
+            "token_offset": token_offset,
+        }
+        if with_provenance else None
+    )
     if not with_labels:
+        if with_provenance:
+            return inputs, segment_mask, provenance
         return inputs, segment_mask
     labels = {
         "start_class": start_class,
@@ -392,6 +707,8 @@ def collate_packed(row_items: Sequence[list], tokenizer, *,
         "cls": cls,
         "segment_mask": segment_mask,
     }
+    if with_provenance:
+        return inputs, labels, provenance
     return inputs, labels
 
 
@@ -426,6 +743,8 @@ class PackedDataLoader:
         rows_per_batch: int,
         max_segments: int = DEFAULT_MAX_SEGMENTS,
         open_rows: int = DEFAULT_OPEN_ROWS,
+        splitting: str = "off",
+        min_fragment: int = DEFAULT_MIN_FRAGMENT,
         n_jobs: int = 4,
         read_window: Optional[int] = None,
         read_retries: int = 3,
@@ -446,6 +765,8 @@ class PackedDataLoader:
         self.rows_per_batch = max(1, int(rows_per_batch))
         self.max_segments = max(1, int(max_segments))
         self.open_rows = max(1, int(open_rows))
+        self.splitting = parse_pack_splitting(splitting)
+        self.min_fragment = max(1, int(min_fragment))
         self.n_jobs = max(1, n_jobs)
         self.read_window = (
             int(read_window) if read_window is not None else self.n_jobs * 8
@@ -454,7 +775,10 @@ class PackedDataLoader:
         self.pad_last = pad_last
         self._epoch = 0
         self._last_stats: Optional[dict] = None
-        self._len_cache: Dict[int, int] = {}
+        # planning-meta cache: (length, start_id, end_id) tuples, keyed by
+        # plain index (single-process planning) or (epoch_key, index)
+        # (oracle reads) — see epoch_item_meta / oracle_epoch_meta
+        self._len_cache: Dict[Any, tuple] = {}
 
     def set_epoch(self, epoch: int) -> None:
         self._epoch = epoch
@@ -467,29 +791,40 @@ class PackedDataLoader:
 
     # -- planning ---------------------------------------------------------
 
+    def _make_packer(self) -> SequencePacker:
+        """One packer configured exactly like the live epoch's — shared by
+        iteration, the oracle plan, and the step simulation so all three
+        replay the identical (split) plan."""
+        return SequencePacker(
+            self.max_seq_len, max_segments=self.max_segments,
+            open_rows=self.open_rows, splitting=self.splitting,
+            min_fragment=self.min_fragment,
+        )
+
     def planned_epoch_steps(self, epoch: int) -> int:
         """Planned batch count of one epoch: simulate the packer over the
-        epoch's item lengths (one length read per unique index, cached; on
-        corpora past ``PLAN_SAMPLE_ITEMS`` the simulation runs on the epoch
+        epoch's item metas (one read per unique index, cached; on corpora
+        past ``PLAN_SAMPLE_ITEMS`` the simulation runs on the epoch
         ordering's prefix and the row count is scaled — a whole extra
-        tokenize pass before step 1 would dwarf what the plan buys). This
-        is what the LR schedule should size against — ``len(self)`` is the
-        pad-to-max upper bound and overshoots by ~the packing factor."""
+        tokenize pass before step 1 would dwarf what the plan buys). The
+        simulation replays EVERY split decision — cuts are a pure function
+        of ``(length, span, holes)`` and the metas carry the spans — so on
+        a fully-read corpus planned == consumed even under
+        ``--pack_splitting fill``. This is what the LR schedule should size
+        against — ``len(self)`` is the pad-to-max upper bound and
+        overshoots by ~the packing factor."""
 
-        def simulate(lengths):
-            packer = SequencePacker(
-                self.max_seq_len, max_segments=self.max_segments,
-                open_rows=self.open_rows,
-            )
+        def simulate(metas):
+            packer = self._make_packer()
             rows = 0
-            for length in lengths:
-                rows += len(packer.add(None, length))
+            for meta in metas:
+                rows += len(packer.add(None, meta[0], _meta_span(meta)))
             return rows + len(packer.flush())
 
         rows = plan_scaled_count(
             self.dataset, self.sampler, epoch, cache=self._len_cache,
             n_jobs=self.n_jobs, read_retries=self.read_retries,
-            simulate=simulate, oracle=self.process_count > 1,
+            simulate=simulate, oracle=self.process_count > 1, meta=True,
         )
         if self.pad_last:
             return -(-rows // self.rows_per_batch)
@@ -497,58 +832,115 @@ class PackedDataLoader:
 
     # -- iteration --------------------------------------------------------
 
-    def _emit(self, rows: List[list], stats: dict, *, real_rows=None):
-        real = len(rows) if real_rows is None else int(real_rows)
-        real_items = [it for row in rows[:real] for it in row]
-        inputs, labels = collate_packed(
-            rows, self.tokenizer, max_seq_len=self.max_seq_len,
-            max_segments=self.max_segments,
-        )
-        if real < len(rows):
-            # pad rows must not look like real examples
-            labels["segment_mask"][real:] = 0
-        segments = int(labels["segment_mask"].sum())
-        stats["real_tokens"] += sum(len(it.input_ids) for it in real_items)
-        stats["physical_tokens"] += len(rows) * self.max_seq_len
-        stats["padmax_tokens"] += len(real_items) * self.max_seq_len
-        stats["rows"] += real
-        stats["batches"] += 1
-        stats["items"] += len(real_items)
-        return PackedBatch(
-            inputs=inputs, labels=labels, rows=len(rows), segments=segments,
-            seq=self.max_seq_len,
-        )
-
-    def _iter_oracle(self):
-        """Multi-host epoch: plan globally from oracle lengths, collate the
-        local row slice. Every host computes the identical plan (pure
-        function of the deterministic epoch ordering + oracle lengths), so
-        per-step shapes, segment counts and stats agree bit-for-bit across
-        hosts while each host only materializes 1/process_count of the
-        rows for the device."""
-        indices = [int(i) for i in self.sampler.epoch_indices(self._epoch)]
-        self._last_stats = stats = {
+    def _new_stats(self) -> dict:
+        return {
             "real_tokens": 0,
+            "supervised_tokens": 0,
             "physical_tokens": 0,
             "padmax_tokens": 0,
             "rows": 0,
             "batches": 0,
             "items": 0,
             "dropped_items": 0,
+            "split_count": 0,
+            "fragment_rows": 0,
+            "fragment_size_hist": {},
         }
-        lengths = oracle_epoch_lengths(
+
+    @staticmethod
+    def _count_fragments(rows: Sequence[list], stats: dict,
+                         tokens_of=None) -> None:
+        """Splitter accounting over emitted REAL rows: ``split_count`` cuts
+        (one per non-head fragment), ``fragment_rows`` rows holding at
+        least one fragment, and a power-of-two fragment-size histogram."""
+        tokens_of = tokens_of or _entry_tokens
+        for row in rows:
+            has_frag = False
+            for entry in row:
+                if not isinstance(entry, ChunkFragment):
+                    continue
+                has_frag = True
+                if entry.index > 0:
+                    stats["split_count"] += 1
+                n = tokens_of(entry)
+                lo = 32
+                while lo < n and lo < 512:
+                    lo *= 2
+                key = f"<={lo}" if n <= lo else f">{lo}"
+                hist = stats["fragment_size_hist"]
+                hist[key] = hist.get(key, 0) + 1
+            if has_frag:
+                stats["fragment_rows"] += 1
+
+    def _emit(self, rows: List[list], stats: dict, *, real_rows=None):
+        real = len(rows) if real_rows is None else int(real_rows)
+        real_entries = [entry for row in rows[:real] for entry in row]
+        splitting = self.splitting != "off"
+        collated = collate_packed(
+            rows, self.tokenizer, max_seq_len=self.max_seq_len,
+            max_segments=self.max_segments, with_provenance=splitting,
+        )
+        inputs, labels = collated[0], collated[1]
+        provenance = collated[2] if splitting else None
+        if real < len(rows):
+            # pad rows must not look like real examples
+            labels["segment_mask"][real:] = 0
+        segments = int(labels["segment_mask"].sum())
+        # token accounting is per-ENTRY: a fragment contributes its own
+        # slice (its siblings contribute theirs), so a split chunk's tokens
+        # are counted exactly once; item counts follow the label carriers
+        # (one per original example). real_tokens = PLACED (non-pad) tokens
+        # — what padding_waste_pct complements; supervised_tokens excludes
+        # sibling fragments' tokens, whose labels are ignore-indexed and
+        # which (under block-diagonal attention) feed no gradient — the
+        # honest numerator of the train-side packing_efficiency, so
+        # hole-filling fragments can never inflate it
+        n_examples = sum(1 for e in real_entries if _entry_is_example(e))
+        stats["real_tokens"] += sum(_entry_tokens(e) for e in real_entries)
+        stats["supervised_tokens"] += sum(
+            _entry_tokens(e) for e in real_entries if _entry_is_example(e)
+        )
+        stats["physical_tokens"] += len(rows) * self.max_seq_len
+        stats["padmax_tokens"] += n_examples * self.max_seq_len
+        stats["rows"] += real
+        stats["batches"] += 1
+        stats["items"] += n_examples
+        self._count_fragments(rows[:real], stats)
+        return PackedBatch(
+            inputs=inputs, labels=labels, rows=len(rows), segments=segments,
+            seq=self.max_seq_len, provenance=provenance,
+        )
+
+    def _iter_oracle(self):
+        """Multi-host epoch: plan globally from oracle metas, collate the
+        local row slice. Every host computes the identical plan — split
+        decisions included, since cuts are a pure function of the oracle's
+        ``(length, span)`` metas — so per-step shapes, segment counts and
+        stats agree bit-for-bit across hosts while each host only
+        materializes 1/process_count of the rows for the device."""
+        indices = [int(i) for i in self.sampler.epoch_indices(self._epoch)]
+        self._last_stats = stats = self._new_stats()
+        metas = oracle_epoch_meta(
             self.dataset, indices, cache=self._len_cache,
             n_jobs=self.n_jobs, read_retries=self.read_retries,
             epoch=self._epoch,
         )
-        packer = SequencePacker(
-            self.max_seq_len, max_segments=self.max_segments,
-            open_rows=self.open_rows,
-        )
-        rows: List[list] = []  # each row: list of (index, length)
-        for idx, length in zip(indices, lengths):
-            rows.extend(packer.add((idx, length), length))
+        packer = self._make_packer()
+        # each row: list of (index, length) pairs and/or ChunkFragments
+        # whose .item is such a pair
+        rows: List[list] = []
+        for idx, meta in zip(indices, metas):
+            rows.extend(packer.add((idx, meta[0]), meta[0], _meta_span(meta)))
         rows.extend(packer.flush())
+
+        def entry_index(entry) -> int:
+            return (entry.item if isinstance(entry, ChunkFragment)
+                    else entry)[0]
+
+        def entry_tokens(entry) -> int:
+            if isinstance(entry, ChunkFragment):
+                return entry.length
+            return entry[1]
 
         rpb = self.rows_per_batch
         local_rows = rpb // self.process_count
@@ -564,7 +956,9 @@ class PackedDataLoader:
             if self.pad_last:
                 batches.append((tail, len(tail)))
             else:
-                stats["dropped_items"] += sum(len(r) for r in tail)
+                stats["dropped_items"] += sum(
+                    1 for r in tail for e in r if _entry_is_example(e)
+                )
                 logger.info(
                     "Packed epoch dropped %d tail items in %d partial-batch "
                     "rows (drop_last parity; they re-enter next epoch's "
@@ -579,38 +973,71 @@ class PackedDataLoader:
             return padded[lo:lo + local_rows]
 
         def submit(pool, batch_rows):
-            return [
-                [
-                    pool.submit(
+            # one read per UNIQUE index: fragments of one chunk landing in
+            # this host's slice share a single oracle read (the item is
+            # consumed read-only by the collate slicing), instead of
+            # re-assembling and re-tokenizing the chunk once per fragment
+            futures_by_index: dict = {}
+
+            def read(entry):
+                idx = entry_index(entry)
+                if idx not in futures_by_index:
+                    futures_by_index[idx] = pool.submit(
                         oracle_read, self.dataset, idx,
                         retries=self.read_retries, epoch=ek,
                     )
-                    for idx, _ in row
-                ]
+                return futures_by_index[idx]
+
+            return [
+                [read(entry) for entry in row]
                 for row in local_slice(batch_rows)
             ]
 
+        def materialize(entry, item):
+            """Plan entry + its oracle-read item -> collate entry (the raw
+            item, or the fragment re-pointed at it)."""
+            if isinstance(entry, ChunkFragment):
+                return dataclasses.replace(entry, item=item)
+            return item
+
         def emit_global(batch_rows, real_rows, row_items):
-            inputs, labels = collate_packed(
+            splitting = self.splitting != "off"
+            collated = collate_packed(
                 row_items, self.tokenizer, max_seq_len=self.max_seq_len,
-                max_segments=self.max_segments,
+                max_segments=self.max_segments, with_provenance=splitting,
             )
+            inputs, labels = collated[0], collated[1]
+            provenance = collated[2] if splitting else None
             # zero the mask of LOCAL rows that are global pad rows
             for r in range(local_rows):
                 if lo + r >= real_rows:
                     labels["segment_mask"][r] = 0
-            real_items = [it for row in batch_rows[:real_rows] for it in row]
-            stats["real_tokens"] += sum(length for _, length in real_items)
+            real_entries = [
+                e for row in batch_rows[:real_rows] for e in row
+            ]
+            n_examples = sum(
+                1 for e in real_entries if _entry_is_example(e)
+            )
+            stats["real_tokens"] += sum(
+                entry_tokens(e) for e in real_entries
+            )
+            stats["supervised_tokens"] += sum(
+                entry_tokens(e) for e in real_entries
+                if _entry_is_example(e)
+            )
             stats["physical_tokens"] += rpb * self.max_seq_len
-            stats["padmax_tokens"] += len(real_items) * self.max_seq_len
+            stats["padmax_tokens"] += n_examples * self.max_seq_len
             stats["rows"] += real_rows
             stats["batches"] += 1
-            stats["items"] += len(real_items)
-            # GLOBAL segment count: what row-weighted metrics key on
-            segments = sum(len(row) for row in batch_rows[:real_rows])
+            stats["items"] += n_examples
+            self._count_fragments(
+                batch_rows[:real_rows], stats, tokens_of=entry_tokens
+            )
+            # GLOBAL example count: what row-weighted metrics key on
+            segments = n_examples
             return PackedBatch(
                 inputs=inputs, labels=labels, rows=rpb, segments=segments,
-                seq=self.max_seq_len,
+                seq=self.max_seq_len, provenance=provenance,
             )
 
         # ONE pool for the epoch, reads submitted a batch ahead: the next
@@ -624,7 +1051,15 @@ class PackedDataLoader:
                 futures = pending.popleft()
                 if i + 2 < len(batches):
                     pending.append(submit(pool, batches[i + 2][0]))
-                row_items = [[f.result() for f in row] for row in futures]
+                row_items = [
+                    [
+                        materialize(entry, f.result())
+                        for entry, f in zip(plan_row, frow)
+                    ]
+                    for plan_row, frow in zip(
+                        local_slice(batch_rows), futures
+                    )
+                ]
                 yield emit_global(batch_rows, real_rows, row_items)
 
     def __iter__(self):
@@ -632,19 +1067,8 @@ class PackedDataLoader:
             yield from self._iter_oracle()
             return
         indices = [int(i) for i in self.sampler.epoch_indices(self._epoch)]
-        self._last_stats = stats = {
-            "real_tokens": 0,
-            "physical_tokens": 0,
-            "padmax_tokens": 0,
-            "rows": 0,
-            "batches": 0,
-            "items": 0,
-            "dropped_items": 0,
-        }
-        packer = SequencePacker(
-            self.max_seq_len, max_segments=self.max_segments,
-            open_rows=self.open_rows,
-        )
+        self._last_stats = stats = self._new_stats()
+        packer = self._make_packer()
         pending_rows: List[list] = []
 
         def drain():
@@ -673,7 +1097,11 @@ class PackedDataLoader:
                     nxt = next(it, None)
                     if nxt is not None:
                         futures.append(pool.submit(read, nxt))
-                    pending_rows.extend(packer.add(item, len(item.input_ids)))
+                    pending_rows.extend(
+                        packer.add(
+                            item, len(item.input_ids), _item_span(item)
+                        )
+                    )
                     yield from drain()
         pending_rows.extend(packer.flush())
         yield from drain()
@@ -686,7 +1114,13 @@ class PackedDataLoader:
                     real_rows=real,
                 )
             else:
-                stats["dropped_items"] += sum(len(r) for r in pending_rows)
+                # drop-accounting follows the label carriers: an example
+                # whose keep_labels fragment sits in a dropped tail row is
+                # dropped (whatever sibling context landed earlier carries
+                # segment_mask 0 anyway), so items + dropped == visited
+                stats["dropped_items"] += sum(
+                    1 for r in pending_rows for e in r if _entry_is_example(e)
+                )
                 logger.info(
                     "Packed epoch dropped %d tail items in %d partial-batch "
                     "rows (drop_last parity; they re-enter next epoch's "
@@ -697,18 +1131,26 @@ class PackedDataLoader:
     @property
     def epoch_stats(self) -> Optional[dict]:
         """Token accounting of the last (or in-progress) epoch:
-        ``packing_efficiency`` = real tokens / physical tokens (the
-        headline sequence-packing metric), ``padding_waste_pct`` its
-        complement, ``padmax_waste_pct`` what the pad-to-max path would
-        have wasted on the same items."""
+        ``padding_waste_pct`` = the PAD fraction of physical tokens (the
+        FLOP-waste number the splitting packer drives down);
+        ``packing_efficiency`` = SUPERVISED tokens / physical tokens — it
+        deliberately excludes sibling fragments' ignore-indexed tokens
+        (label-less, gradient-less under block-diagonal attention), so a
+        run that fills every hole with unsupervised fragments cannot
+        report a dishonest 1.0; without splitting the two numbers are
+        complements as before. ``padmax_waste_pct`` is what the pad-to-max
+        path would have wasted on the same items."""
         s = self._last_stats
         if not s:
             return None
         out = dict(s)
         if s["physical_tokens"]:
-            eff = s["real_tokens"] / s["physical_tokens"]
-            out["packing_efficiency"] = round(eff, 4)
-            out["padding_waste_pct"] = round(100.0 * (1.0 - eff), 2)
+            out["packing_efficiency"] = round(
+                s["supervised_tokens"] / s["physical_tokens"], 4
+            )
+            out["padding_waste_pct"] = round(
+                100.0 * (1.0 - s["real_tokens"] / s["physical_tokens"]), 2
+            )
         if s["padmax_tokens"]:
             out["padmax_waste_pct"] = round(
                 100.0 * (1.0 - s["real_tokens"] / s["padmax_tokens"]), 2

@@ -42,14 +42,22 @@ from ..data.collate import rebind_collate_seq
 from ..data.loader import ListDataloader
 from ..data.packing import (
     DEFAULT_MAX_SEGMENTS,
+    DEFAULT_MIN_FRAGMENT,
     SequencePacker,
     collate_packed,
+    parse_pack_splitting,
     parse_sequence_packing,
 )
 from ..parallel import build_mesh, gather_to_host, make_global_array
 from ..serve.bucketing import pad_trailing_batch
 from ..utils.pipeline import LaggedConsumer
-from .score import OUT_KEYS, build_packed_score_fn, build_score_fn
+from .score import (
+    OUT_KEYS,
+    PACKED_OUT_KEYS,
+    FragmentMerger,
+    build_packed_score_fn,
+    build_score_fn,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -117,6 +125,8 @@ class Predictor:
         length_buckets: Optional[list] = None,
         sequence_packing=False,
         pack_max_segments: int = DEFAULT_MAX_SEGMENTS,
+        pack_splitting="off",
+        pack_min_fragment: int = DEFAULT_MIN_FRAGMENT,
     ):
         self.model = model
         self.params = params
@@ -175,8 +185,21 @@ class Predictor:
         # once per segment with chunk-relative spans and its own [CLS]
         # anchor (infer/score.build_packed_score_fn), so per-chunk scores
         # pin to the pad-to-max path's. Supersedes length_buckets.
+        # pack_splitting='fill' additionally splits chunks that fit no open
+        # row into hole-filling fragments; their per-fragment span logits
+        # re-merge host-side into per-chunk outputs (score.FragmentMerger:
+        # offset-shifted argmax over the concatenated fragments) BEFORE
+        # candidate tracking, so everything downstream of process() sees
+        # per-chunk outputs unchanged. Fragments attend only within
+        # themselves (block-diagonal), so split-chunk logits are an
+        # approximation of the unsplit chunk's — exact for attention-free
+        # heads, within model tolerance otherwise.
         self._packing = parse_sequence_packing(sequence_packing)
         self._pack_max_segments = max(1, int(pack_max_segments))
+        self._pack_splitting = parse_pack_splitting(pack_splitting)
+        self._pack_min_fragment = max(1, int(pack_min_fragment))
+        # observability: fragments/cuts performed by the last run's packer
+        self.pack_split_count = 0
         if self._packing:
             kw = getattr(self.collate_fun, "keywords", {}) or {}
             if kw.get("tokenizer") is None:
@@ -301,6 +324,10 @@ class Predictor:
     def __call__(self, dataset, *, save_dump: bool = False):
         if self._jit_fwd is None:
             self._jit_fwd = self._build_fwd()
+        # per-run splitter observability (a previous run's packer must not
+        # leak its split count into a run that never built one)
+        self._live_packer = None
+        self.pack_split_count = 0
 
         bucketed = self._seq_grid is not None
         packing = self._packing
@@ -325,17 +352,39 @@ class Predictor:
                 total=self.limit,
             )
 
+        merger = FragmentMerger() if (
+            packing and self._pack_splitting != "off"
+        ) else None
+
         def process(packed, n_valid, items) -> None:
             if packing:
-                # [6, R, S] per-segment outputs -> per-chunk vectors through
+                # [8, R, S] per-segment outputs -> per-chunk vectors through
                 # the packing map (row-major segment order over the mask);
                 # ``n_valid`` is the host-side [R, S] segment_mask
                 m = np.asarray(n_valid).reshape(-1) > 0
                 out = {
                     k: packed[i].reshape(-1)[m]
-                    for i, k in enumerate(self._OUT_KEYS)
+                    for i, k in enumerate(PACKED_OUT_KEYS)
                 }
                 assert len(items) == int(m.sum()), (len(items), int(m.sum()))
+                if merger is not None:
+                    # entries may be ChunkFragments: buffer them until their
+                    # chunk is complete (fragments routinely span batches),
+                    # then re-merge into per-chunk outputs — everything
+                    # below this point sees whole chunks only
+                    done_items: list = []
+                    done_fields: dict = {k: [] for k in self._OUT_KEYS}
+                    for j, entry in enumerate(items):
+                        fields = {k: out[k][j] for k in PACKED_OUT_KEYS}
+                        for item, merged in merger.add(entry, fields):
+                            done_items.append(item)
+                            for k in self._OUT_KEYS:
+                                done_fields[k].append(merged[k])
+                    items = done_items
+                    out = {
+                        k: np.asarray(v, dtype=np.float32)
+                        for k, v in done_fields.items()
+                    }
             else:
                 out = {
                     k: packed[i, :n_valid]
@@ -350,7 +399,8 @@ class Predictor:
                      out["labels"], items)
                 )
 
-        # Grouped output fetching: completed [6, B] outputs accumulate on
+        # Grouped output fetching: completed [6, B] outputs ([8, R, S]
+        # on the packed path) accumulate on
         # device and are gathered ``fetch_every`` at a time in ONE
         # device->host transfer (a jnp.stack + one gather), while 2 newer
         # batches stay in flight (the depth-2 lag that hides per-batch
@@ -369,7 +419,7 @@ class Predictor:
 
         # Bucketed batches have per-bucket shapes, so the grouped fetch's
         # jnp.stack cannot apply — fetch per batch there. Packed batches
-        # fetch per batch too (the [6, R, S] output must pair with its own
+        # fetch per batch too (the [8, R, S] output must pair with its own
         # host-side segment mask).
         group_n = (
             self.fetch_every
@@ -423,8 +473,11 @@ class Predictor:
                 tok = self.collate_fun.keywords["tokenizer"]
                 max_len = int(self.collate_fun.keywords["max_seq_len"])
                 packer = SequencePacker(
-                    max_len, max_segments=self._pack_max_segments
+                    max_len, max_segments=self._pack_max_segments,
+                    splitting=self._pack_splitting,
+                    min_fragment=self._pack_min_fragment,
                 )
+                self._live_packer = packer
                 pending: list = []
 
                 def packed_batch(rows):
@@ -452,7 +505,10 @@ class Predictor:
                 for group in iterator:  # raw chunk lists
                     for chunk in group:
                         pending.extend(
-                            packer.add(chunk, len(chunk.input_ids))
+                            packer.add(
+                                chunk, len(chunk.input_ids),
+                                (chunk.start_id, chunk.end_id),
+                            )
                         )
                         while len(pending) >= self.batch_size:
                             yield packed_batch(pending[: self.batch_size])
@@ -566,6 +622,24 @@ class Predictor:
                     except queue.Empty:
                         break
                 _ensure_worker_stopped(worker, timeout=10)
+
+        if packing:
+            live = getattr(self, "_live_packer", None)
+            self.pack_split_count = live.split_count if live else 0
+            if self.pack_split_count:
+                logger.info(
+                    "Sequence packing split %d chunk(s) into hole-filling "
+                    "fragments (re-merged to per-chunk outputs).",
+                    self.pack_split_count,
+                )
+        if merger is not None and merger.pending:
+            # every fragment is collated and scored (eval pads, never
+            # drops), so a leftover here is a re-merge bookkeeping bug —
+            # surface it instead of silently losing chunks
+            logger.warning(
+                "Fragment re-merge finished with %d incomplete chunk(s); "
+                "their candidates were dropped.", merger.pending,
+            )
 
         return self
 

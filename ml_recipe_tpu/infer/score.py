@@ -37,6 +37,15 @@ import jax
 OUT_KEYS = ("scores", "start_ids", "end_ids", "start_regs", "end_regs",
             "labels")
 
+# Row order of the sequence-packed [8, R, S] output: OUT_KEYS plus the raw
+# per-segment span-logit maxima. The two extra rows are what the host-side
+# fragment re-merge needs — a split chunk's merged argmax is the argmax
+# over its fragments' (max, argmax) pairs, and the answerability score's
+# [CLS] anchor is recovered from the head fragment's rows (anchor =
+# start_max + end_max - score). Whole-chunk consumers read only the first
+# six rows.
+PACKED_OUT_KEYS = OUT_KEYS + ("start_max", "end_max")
+
 
 def build_packed_score_fn(model) -> Callable:
     """The sequence-packing twin of :func:`build_score_fn`: one forward
@@ -45,15 +54,19 @@ def build_packed_score_fn(model) -> Callable:
     ``f(params, planes, segment_starts)`` where ``planes`` is ``[4, R, L]``
     int32 (input_ids / token_type_ids / segment_ids / position_ids — the
     attention mask is ``segment_ids > 0``, derived in-jit) and
-    ``segment_starts`` is ``[R, S]`` int32. Output is ``[6, R, S]`` f32 in
-    ``OUT_KEYS`` row order, per SEGMENT:
+    ``segment_starts`` is ``[R, S]`` int32. Output is ``[8, R, S]`` f32 in
+    ``PACKED_OUT_KEYS`` row order, per SEGMENT:
 
-    - span ids are CHUNK-RELATIVE (row argmax minus the segment's start
-      offset), so candidate validity rules (``start >= question_len + 2``)
-      apply unchanged;
+    - span ids are SEGMENT-RELATIVE (row argmax minus the segment's start
+      offset) — chunk-relative for whole chunks, so candidate validity
+      rules (``start >= question_len + 2``) apply unchanged; fragment
+      segments are rebased by their ``token_offset`` in the host-side
+      re-merge (:class:`FragmentMerger`);
     - the answerability score's [CLS] anchor is each segment's OWN start
       row (``start[:, s, seg_start]``) — for a single full-length segment
-      this is exactly the unpacked ``start[:, 0]``.
+      this is exactly the unpacked ``start[:, 0]``;
+    - the trailing ``start_max``/``end_max`` rows carry the per-segment
+      span-logit maxima the fragment re-merge combines.
 
     Absent segments produce garbage entries the caller drops through the
     host-side ``segment_mask`` (the packing map).
@@ -102,12 +115,93 @@ def build_packed_score_fn(model) -> Callable:
             "start_regs": preds["start_reg"],
             "end_regs": preds["end_reg"],
             "labels": cls_ids,
+            "start_max": start_logits,
+            "end_max": end_logits,
         }
         return jnp.stack(
-            [fields[k].astype(jnp.float32) for k in OUT_KEYS], axis=0
+            [fields[k].astype(jnp.float32) for k in PACKED_OUT_KEYS], axis=0
         )
 
     return score_fn
+
+
+class FragmentMerger:
+    """Host-side re-merge of split-chunk outputs (``--pack_splitting``).
+
+    Feeds on ``(entry, fields)`` pairs in any order — ``entry`` is a pack
+    collate entry (a whole ChunkItem, passed through untouched, or a
+    ``data.packing.ChunkFragment``) and ``fields`` its per-segment
+    ``PACKED_OUT_KEYS`` scalars. Fragments buffer per ``chunk_id`` until
+    the whole chunk has reported (fragments of one chunk routinely land in
+    DIFFERENT packed batches), then merge into per-chunk fields identical
+    in shape to a whole chunk's:
+
+    - merged span ids: argmax over the concatenated fragments — the
+      winning fragment is the one with the larger span-logit max, its
+      segment-relative argmax shifted by its ``token_offset``;
+    - merged score: best ``start_max`` + best ``end_max`` minus the [CLS]
+      anchor recovered from the HEAD fragment (``anchor = head.start_max +
+      head.end_max - head.score`` — the head starts at chunk position 0,
+      so its per-segment anchor IS ``start[0] + end[0]``);
+    - ``start_regs``/``end_regs``/``labels``: the head fragment's (its
+      pooled row is the chunk's [CLS], same as the unsplit pooler input).
+
+    Downstream consumers (candidate tracking, dump, serving-side parity
+    reductions) therefore see per-CHUNK outputs, exactly as with splitting
+    off.
+    """
+
+    def __init__(self):
+        self._pending: dict = {}  # chunk_id -> {fragment_index: (frag, fields)}
+
+    def add(self, entry, fields: dict) -> list:
+        """Feed one segment's outputs; returns the (possibly empty) list of
+        completed ``(chunk_item, fields)`` pairs this feed unlocked."""
+        from ..data.packing import ChunkFragment
+
+        if not isinstance(entry, ChunkFragment):
+            return [(entry, fields)]
+        parts = self._pending.setdefault(entry.chunk_id, {})
+        parts[entry.index] = (entry, fields)
+        count = entry.count  # stamped on every fragment at placement time
+        if count and len(parts) == count:
+            del self._pending[entry.chunk_id]
+            return [self._merge([parts[i] for i in range(count)])]
+        return []
+
+    @property
+    def pending(self) -> int:
+        """Chunks still waiting for fragments (0 after a full stream)."""
+        return len(self._pending)
+
+    @staticmethod
+    def _merge(parts):
+        head, head_fields = parts[0]
+        assert head.index == 0 and head.offset == 0, (
+            "head fragment missing from re-merge"
+        )
+
+        def best(key_max, key_id):
+            frag, fields = max(parts, key=lambda p: p[1][key_max])
+            return fields[key_max], frag.offset + int(fields[key_id])
+
+        start_max, start_id = best("start_max", "start_ids")
+        end_max, end_id = best("end_max", "end_ids")
+        anchor = (
+            head_fields["start_max"] + head_fields["end_max"]
+            - head_fields["scores"]
+        )
+        merged = {
+            "scores": start_max + end_max - anchor,
+            "start_ids": start_id,
+            "end_ids": end_id,
+            "start_regs": head_fields["start_regs"],
+            "end_regs": head_fields["end_regs"],
+            "labels": head_fields["labels"],
+            "start_max": start_max,
+            "end_max": end_max,
+        }
+        return head.item, merged
 
 
 def build_score_fn(

@@ -15,10 +15,13 @@ mechanism for every regime:
 - candidates are ranked by that cost and validated IN RANK ORDER with a real
   ``jit(...).lower(...).compile()`` probe of the same ``pallas_call`` the
   execution path builds; when the probes hand back their compiled objects,
-  legal candidates are re-ranked by XLA's own ``cost_analysis()`` estimates
-  (measured properties of the lowered programs — fusions and layout copies
-  included) and the cheapest wins, the analytic prior deciding only walk
-  order and ties; bool-style probes keep first-legal-wins;
+  legal candidates are re-ranked by MEASUREMENT — a few wall-clock
+  executions of each compiled probe when the programs run here (median
+  ``probe_ms`` persisted per candidate, fastest wins), else XLA's own
+  ``cost_analysis()`` estimates (measured properties of the lowered
+  programs — fusions and layout copies included) — with the analytic prior
+  deciding only walk order and ties; bool-style probes keep
+  first-legal-wins;
 - off-TPU (CPU / interpret mode, where Mosaic cannot OOM VMEM and tier-1
   runs) selection falls back to the caller's analytic pick — the exact
   arithmetic the old gates used, so CPU behavior is unchanged;
@@ -195,6 +198,68 @@ def combine_for_ranking(*compiled):
     if not compiled or any(not c for c in compiled):
         return False
     return _CombinedCompiled(compiled)
+
+
+# Timed executions per compiled probe for the wall-clock ranking signal
+# (one extra warmup execution absorbs first-dispatch overhead). Three keeps
+# the added probe cost at microbenchmark scale while the median rejects a
+# one-off scheduling hiccup.
+_PROBE_TIME_REPEATS = 3
+
+
+def _time_compiled(compiled, *, repeats: int = _PROBE_TIME_REPEATS):
+    """Median wall-clock execution time (ms) of one compiled probe, or
+    ``None`` when the program cannot be executed here (no ``args_info``,
+    not callable, or execution fails — timing is best-effort by contract).
+
+    Inputs are ZERO-FILLED from the compiled program's own argument avals:
+    the probe path never has the caller's real tensors, and attention-shaped
+    kernels' run time is data-independent. Multi-leg candidates
+    (:class:`_CombinedCompiled`) time as the sum of their legs — a
+    candidate that must run forward AND backward costs both."""
+    if isinstance(compiled, _CombinedCompiled):
+        total = 0.0
+        for leg in compiled._compiled:
+            ms = _time_compiled(leg, repeats=repeats)
+            if ms is None:
+                return None
+            total += ms
+        return total
+    info = getattr(compiled, "args_info", None)
+    if info is None or not callable(compiled):
+        return None
+    import time
+
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        def zero(a):
+            shape = getattr(a, "shape", None)
+            dtype = getattr(a, "dtype", None)
+            if shape is None or dtype is None:
+                aval = getattr(a, "aval", None)
+                shape, dtype = aval.shape, aval.dtype
+            return jnp.zeros(shape, dtype)
+
+        zeroed = jax.tree_util.tree_map(zero, info)
+        if (isinstance(zeroed, tuple) and len(zeroed) == 2
+                and isinstance(zeroed[1], dict)):
+            args, kwargs = zeroed
+        else:
+            args, kwargs = tuple(zeroed), {}
+        jax.block_until_ready(compiled(*args, **kwargs))  # warmup dispatch
+        samples = []
+        for _ in range(max(1, int(repeats))):
+            t0 = time.perf_counter()
+            jax.block_until_ready(compiled(*args, **kwargs))
+            samples.append((time.perf_counter() - t0) * 1e3)
+        samples.sort()
+        return samples[len(samples) // 2]
+    except Exception as e:  # noqa: BLE001 - timing is a ranking extra only
+        logger.debug("autotune: probe timing failed (%s: %s)",
+                     type(e).__name__, e)
+        return None
 
 
 @dataclasses.dataclass
@@ -433,10 +498,11 @@ class GeometryAutotuner:
 
             stored = list(geometry) if isinstance(geometry, tuple) else geometry
             entry = {"geometry": stored, "source": source}
-            if ranking == "measured":
-                # persist the ranking signal: which estimates the winner
-                # beat, and that the verdict came from compiled-cost
-                # ranking rather than the analytic prior
+            if ranking in ("measured", "timed"):
+                # persist the ranking signal: which estimates (and, when
+                # the probes executed, which measured probe_ms timings) the
+                # winner beat, and that the verdict came from measurement
+                # rather than the analytic prior
                 entry["ranking"] = ranking
                 entry["cost_estimates"] = estimates
             if geometry is None:
@@ -452,16 +518,24 @@ class GeometryAutotuner:
 
     def _probe_ranked(self, candidates, cost, probe):
         """Probe-validate candidates and pick the winner, preferring
-        measured compiled-cost ranking over the analytic prior.
+        measured signals over the analytic prior — wall-clock probe
+        timings first, compiled-cost estimates second.
 
         Candidates are walked in ascending prior-cost order. A probe that
         returns a bare ``True`` keeps the legacy contract — the first legal
         candidate wins and the walk stops (nothing to rank by). A probe
-        that returns the *compiled object* opts into timing-ranked
-        selection: every candidate is probed, ``compiled.cost_analysis()``
-        estimates are collected, and the winner is the legal candidate with
-        the smallest estimated step cost — the prior decides only the walk
-        order and the tie-break (ROADMAP raw-speed item b).
+        that returns the *compiled object* opts into measured selection:
+        every candidate is probed and ``compiled.cost_analysis()``
+        estimates are collected; then, when every legal candidate's
+        compiled program can actually EXECUTE here, each is timed for a
+        few wall-clock runs (``_time_compiled``) and the fastest median
+        wins (``ranking='timed'``, per-candidate ``probe_ms`` persisted in
+        the tuning cache next to the estimates). When timing is
+        unavailable (the compiled objects don't execute off-device, a run
+        fails) the estimate ranking decides (``'measured'``), and the
+        analytic prior keeps deciding only walk order and ties (ROADMAP
+        raw-speed item b: measured timings > cost estimates > analytic
+        prior).
 
         Probe exceptions before the first legal candidate propagate (the
         legacy safety contract: an unclassified compile error at a
@@ -471,10 +545,11 @@ class GeometryAutotuner:
         rather than killing a selection that already has an answer.
 
         Returns ``(geometry, ranking, estimates)`` with ranking in
-        ``('measured', 'prior', None)``.
+        ``('timed', 'measured', 'prior', None)``.
         """
         legal: List[Any] = []
         estimates: Dict[str, dict] = {}
+        compiled_objs: Dict[str, Any] = {}
         for cand in sorted(candidates, key=cost):
             self.probe_count += 1
             if legal:
@@ -497,9 +572,28 @@ class GeometryAutotuner:
                 # first-legal-wins — further probes buy nothing
                 break
             estimates[_geom_json_key(cand)] = est
+            compiled_objs[_geom_json_key(cand)] = res
         if not legal:
             return None, None, {}
         if len(estimates) == len(legal) and len(legal) > 1:
+            timings: Optional[Dict[str, float]] = {}
+            for cand in legal:
+                key = _geom_json_key(cand)
+                ms = _time_compiled(compiled_objs[key])
+                if ms is None:
+                    # no partial verdicts: ranking two candidates by time
+                    # and the rest by estimate would compare incomparable
+                    # units — all-or-nothing keeps the order meaningful
+                    timings = None
+                    break
+                timings[key] = ms
+            if timings:
+                for key, ms in timings.items():
+                    estimates[key]["probe_ms"] = round(ms, 4)
+                winner = min(
+                    legal, key=lambda c: timings[_geom_json_key(c)]
+                )
+                return winner, "timed", estimates
             winner = min(
                 legal, key=lambda c: estimates[_geom_json_key(c)]["est_seconds"]
             )

@@ -45,8 +45,10 @@ from ..data.device_prefetch import DevicePrefetcher
 from ..data.loader import DataLoader, ShardedBatchSampler
 from ..data.packing import (
     DEFAULT_MAX_SEGMENTS,
+    DEFAULT_MIN_FRAGMENT,
     PackedBatch,
     PackedDataLoader,
+    parse_pack_splitting,
     parse_sequence_packing,
 )
 from ..losses import PackedWeightedLoss
@@ -255,6 +257,18 @@ class Trainer:
     # Per-row segment cap: the static S of the [rows, S] label planes and
     # per-segment head outputs.
     pack_max_segments: int = DEFAULT_MAX_SEGMENTS
+    # Hole-filling chunk splitting (--pack_splitting off|fill): a chunk
+    # that fits no open pack row is split at a label-safe token boundary
+    # and its head fragment fills the largest residual hole — the only
+    # path below the ~1.6% waste floor quantized chunk mixes impose on any
+    # non-splitting packer. 'off' (default) is the pre-splitting packer
+    # bit-exactly (pinned in tests/test_dp_equivalence.py). Fragments are
+    # ordinary segments; only the gold-span-bearing one carries labels
+    # (siblings get ignore-index via segment_mask 0), so examples are
+    # never double-counted by the packed loss or row-weighted metrics.
+    pack_splitting: Any = "off"
+    # No fragment goes below this many tokens (head or tail).
+    pack_min_fragment: int = DEFAULT_MIN_FRAGMENT
 
     # Double-buffered device prefetch (data/device_prefetch.py): keep this
     # many placed global batches in flight on a background thread so the
@@ -340,13 +354,16 @@ class Trainer:
                     max_seq_len=self._collate_max_seq_len(),
                     rows_per_batch=self.train_batch_size,
                     max_segments=self.pack_max_segments,
+                    splitting=self.pack_splitting,
+                    min_fragment=self.pack_min_fragment,
                     n_jobs=self.n_jobs,
                 )
                 logger.info(
                     "Sequence packing: %d rows x %d tokens per step, "
-                    "max %d segments per row (one compiled program).",
+                    "max %d segments per row (one compiled program), "
+                    "splitting %s.",
                     self.train_batch_size, self.train_dataloader.max_seq_len,
-                    self.pack_max_segments,
+                    self.pack_max_segments, self.train_dataloader.splitting,
                 )
             elif self._seq_grid is not None:
                 self.train_dataloader = BucketedDataLoader(
@@ -388,6 +405,8 @@ class Trainer:
                     max_seq_len=self._collate_max_seq_len(),
                     rows_per_batch=self.test_batch_size,
                     max_segments=self.pack_max_segments,
+                    splitting=self.pack_splitting,
+                    min_fragment=self.pack_min_fragment,
                     n_jobs=self.n_jobs,
                     pad_last=True,
                 )
@@ -646,6 +665,9 @@ class Trainer:
         line. Multi-host runs are first-class: the loaders derive every
         host's identical pack plan from the shared length oracle
         (data/packing.oracle_read), so step shapes stay in lockstep."""
+        # validate the splitting spec up front (fail at construction, not
+        # mid-epoch on the loader thread), even when packing is off
+        parse_pack_splitting(self.pack_splitting)
         if not parse_sequence_packing(self.sequence_packing):
             return False
         if self.process_count > 1:
@@ -1575,11 +1597,14 @@ class Trainer:
                         logger.info(
                             "Packed epoch %d: %d batches, packing "
                             "efficiency %.2f%% (padding waste %.2f%%; "
-                            "pad-to-max would waste %.2f%%).",
+                            "pad-to-max would waste %.2f%%; %d splits in "
+                            "%d fragment rows).",
                             epoch_i, stats["batches"],
                             100.0 * stats.get("packing_efficiency", 0.0),
                             stats.get("padding_waste_pct", 0.0),
                             stats.get("padmax_waste_pct", 0.0),
+                            stats.get("split_count", 0),
+                            stats.get("fragment_rows", 0),
                         )
                     else:
                         logger.info(

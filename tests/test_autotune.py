@@ -390,6 +390,117 @@ def test_estimate_extraction_is_best_effort(tuner, monkeypatch):
         _FakeCompiled(flops=0.0, byts=0.0)) is None
 
 
+# ---------------------------------------------------------------------------
+# wall-clock probe timing (ROADMAP raw-speed item b, the measured tier):
+# compiled probes that EXECUTE are timed, probe_ms persists per candidate,
+# and timings outrank the cost estimates which outrank the analytic prior
+# ---------------------------------------------------------------------------
+
+
+class _FakeTimedCompiled(_FakeCompiled):
+    """A compiled-program stand-in that also EXECUTES: args_info says
+    'no arguments' and __call__ burns a deterministic wall-clock cost."""
+
+    def __init__(self, flops, byts, ms):
+        super().__init__(flops, byts)
+        self.ms = float(ms)
+        self.args_info = ()
+        self.calls = 0
+
+    def __call__(self):
+        import time
+
+        self.calls += 1
+        time.sleep(self.ms / 1e3)
+        return np.zeros(())
+
+
+def test_timed_ranking_overrides_cost_estimates(tuner, monkeypatch):
+    """When every legal candidate's compiled probe executes, the winner is
+    the wall-clock FASTEST one — even when both the analytic prior and the
+    cost_analysis estimates rank others first — and per-candidate probe_ms
+    persists in the tuning-cache JSON next to the estimates."""
+    _fake_tpu(monkeypatch)
+    # prior order is [12, 6, 4, 2]; estimates say 4 is cheapest; the
+    # wall clock says 2 is fastest — the wall clock must win
+    est_bytes = {12: 9e9, 6: 6e9, 4: 1e9, 2: 5e9}
+    sleep_ms = {12: 6.0, 6: 4.0, 4: 3.0, 2: 0.5}
+    fakes = {
+        hc: _FakeTimedCompiled(1e9, est_bytes[hc], sleep_ms[hc])
+        for hc in est_bytes
+    }
+
+    assert _select(tuner, probe=lambda hc: fakes[hc]) == 2
+    # warmup + _PROBE_TIME_REPEATS timed runs per candidate
+    assert all(
+        f.calls == 1 + autotune._PROBE_TIME_REPEATS for f in fakes.values()
+    )
+
+    payload = json.loads(tuner._cache_file("FakeTPU v0").read_text())
+    (entry,) = payload["entries"].values()
+    assert entry["geometry"] == 2
+    assert entry["ranking"] == "timed"
+    assert set(entry["cost_estimates"]) == {"12", "6", "4", "2"}
+    for key, est in entry["cost_estimates"].items():
+        assert est["probe_ms"] > 0, key
+        assert est["est_seconds"] > 0, key  # estimates still ride along
+    # the fastest candidate really carries the smallest persisted timing
+    assert min(
+        entry["cost_estimates"], key=lambda k: entry["cost_estimates"][k]["probe_ms"]
+    ) == "2"
+
+    # acceptance: warm restart (fresh process over the same disk cache)
+    # performs ZERO probes and serves the timed winner
+    fresh = autotune.GeometryAutotuner(cache_dir=tuner.cache_dir)
+    assert _select(fresh, probe=lambda hc: pytest.fail("probed on hit")) == 2
+    assert fresh.probe_count == 0
+
+
+def test_timing_unavailable_falls_back_to_cost_estimates(tuner, monkeypatch):
+    """One candidate whose compiled probe cannot execute (no args_info —
+    e.g. a device-resident program on a probe-only host) withdraws the
+    whole timing tier: ranking falls back to the cost estimates, with no
+    partial probe_ms keys (mixing timed and estimated candidates would
+    compare incomparable units)."""
+    _fake_tpu(monkeypatch)
+    est_bytes = {12: 9e9, 6: 6e9, 4: 1e9, 2: 5e9}
+
+    def probe(hc):
+        if hc == 6:  # this one doesn't execute
+            return _FakeCompiled(1e9, est_bytes[hc])
+        return _FakeTimedCompiled(1e9, est_bytes[hc], ms=0.5)
+
+    assert _select(tuner, probe=probe) == 4  # estimate-cheapest
+    (entry,) = tuner._entries["FakeTPU v0"].values()
+    assert entry["ranking"] == "measured"
+    assert all("probe_ms" not in est for est in entry["cost_estimates"].values())
+
+
+def test_time_compiled_unit():
+    """_time_compiled: real compiled jax programs time (zero-filled args
+    from their own args_info), non-executable objects return None, and
+    combined multi-leg candidates sum their legs."""
+    import jax
+    import jax.numpy as jnp
+
+    compiled = jax.jit(lambda x: x * 2).lower(jnp.zeros((8,))).compile()
+    ms = autotune._time_compiled(compiled, repeats=2)
+    assert ms is not None and ms >= 0
+
+    assert autotune._time_compiled(object()) is None
+    assert autotune._time_compiled(_FakeCompiled(1e9, 1e9)) is None
+
+    a = _FakeTimedCompiled(1e9, 1e9, ms=1.0)
+    b = _FakeTimedCompiled(1e9, 1e9, ms=2.0)
+    combined = autotune._CombinedCompiled([a, b])
+    total = autotune._time_compiled(combined, repeats=1)
+    assert total is not None and total >= 2.5  # ~1ms + ~2ms of sleeps
+    # one leg that cannot execute poisons the combined timing
+    assert autotune._time_compiled(
+        autotune._CombinedCompiled([a, _FakeCompiled(1e9, 1e9)])
+    ) is None
+
+
 def test_combine_for_ranking_sums_legs():
     """Multi-program candidates (streaming fwd + dkv) rank by the SUM of
     their legs' estimates; any falsy leg fails the candidate and any

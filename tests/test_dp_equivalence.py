@@ -212,6 +212,36 @@ def test_sp_ring_seq_shard_invariant_with_dropout(tmp_path):
     _assert_same_trajectory(_run(sp), _run(small), params_atol=5e-5)
 
 
+def test_pack_splitting_off_bit_matches_head(tmp_path):
+    """ISSUE 11 acceptance: ``--pack_splitting off`` (the default) is the
+    pre-splitting packed code path bit-exactly — a packed trainer with the
+    flag explicitly off must produce the same trajectory, bit for bit, as
+    one that never saw the flag (guards against splitting-code leakage
+    into the non-splitting packer: placement walk, collate planes, stats
+    and plan must all be untouched)."""
+    from test_packing import _packed_trainer
+
+    off_dir = tmp_path / "off"
+    off_dir.mkdir()
+    default_dir = tmp_path / "default"
+    default_dir.mkdir()
+    off = _packed_trainer(off_dir, pack_splitting="off", pack_min_fragment=4)
+    default = _packed_trainer(default_dir)
+    losses_o, params_o = _run(off)
+    losses_d, params_d = _run(default)
+    assert len(losses_o) == len(losses_d) >= 1
+    assert losses_o == losses_d, (
+        "pack_splitting-off loss trajectory not bit-identical"
+    )
+    for x, y in zip(
+        jax.tree_util.tree_leaves(params_o), jax.tree_util.tree_leaves(params_d)
+    ):
+        np.testing.assert_array_equal(
+            x, y, err_msg="pack_splitting-off final params not bit-identical"
+        )
+    assert off._planned_steps_per_epoch == default._planned_steps_per_epoch
+
+
 def test_sequence_packing_off_bit_matches_head(tmp_path):
     """ISSUE 5 acceptance: ``--sequence_packing off`` (the default) is the
     pre-packing code path bit-exactly — a trainer constructed with the flag

@@ -7,14 +7,17 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from ml_recipe_tpu.data.chunking import label_safe_cut
 from ml_recipe_tpu.data.collate import make_collate_fun
 from ml_recipe_tpu.data.datasets import DatasetItem
 from ml_recipe_tpu.data.loader import ShardedBatchSampler
 from ml_recipe_tpu.data.packing import (
+    ChunkFragment,
     PackedBatch,
     PackedDataLoader,
     SequencePacker,
     collate_packed,
+    parse_pack_splitting,
     parse_sequence_packing,
 )
 from ml_recipe_tpu.losses import PackedWeightedLoss, build_loss
@@ -151,6 +154,319 @@ def test_packer_under_two_pct_on_continuous_nq_mix():
     # every item survived, no row overflows
     assert sorted(x for r in rows for x in r) == sorted(int(n) for n in lengths)
     assert all(sum(r) <= L for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# splitting packer (ISSUE 11): hole-filling chunk fragments
+# ---------------------------------------------------------------------------
+
+
+def test_parse_pack_splitting_domain():
+    for off in (None, False, "off", "none", "0", "false", ""):
+        assert parse_pack_splitting(off) == "off"
+    for fill in (True, "fill", "on", "1", "true", "yes"):
+        assert parse_pack_splitting(fill) == "fill"
+    with pytest.raises(ValueError, match="off|fill"):
+        parse_pack_splitting("sideways")
+
+
+def test_label_safe_cut_arithmetic():
+    # nominal: fill the hole, keep min_fragment on both sides
+    assert label_safe_cut(100, None, 40, 10) == 40
+    # hole bigger than length - min_fragment: the tail floor binds
+    assert label_safe_cut(100, None, 95, 10) == 90
+    # no legal cut: hole below min_fragment, or chunk too short to split
+    assert label_safe_cut(100, None, 5, 10) is None
+    assert label_safe_cut(15, None, 40, 10) is None
+    # span straddling the nominal cut retreats to the span start (the
+    # whole span moves into the tail fragment)
+    assert label_safe_cut(100, (35, 45), 40, 10) == 35
+    assert label_safe_cut(100, (35, 89), 40, 10) == 35
+    # ...and when that violates min_fragment there is no legal cut
+    assert label_safe_cut(100, (5, 89), 40, 10) is None
+    # span wholly on one side never moves the cut
+    assert label_safe_cut(100, (5, 9), 40, 10) == 40
+    assert label_safe_cut(100, (60, 70), 40, 10) == 40
+
+
+def test_splitting_packer_breaks_quantized_floor_with_integrity():
+    """The tentpole number, packer-level: a fully quantized 463-token mix
+    at L=512 floors the NON-splitting packer near 10% (no pair of chunks
+    shares a row), while the splitting packer lands under 1% — and every
+    split chunk reassembles exactly: contiguous offsets, all fragments
+    stamped with the final count, tokens conserved, and the gold span
+    wholly inside the single keep_labels fragment (the
+    never-splits-through-gold-span property, here over randomized spans)."""
+    rng = np.random.default_rng(3)
+    L, n = 512, 1000
+
+    def run(mode):
+        p = SequencePacker(L, splitting=mode, min_fragment=32)
+        rows = []
+        spans = {}
+        for i in range(n):
+            s = int(rng.integers(0, 463 - 2))
+            spans[f"c{i}"] = (s, min(s + int(rng.integers(0, 40)), 462))
+            rows.extend(p.add(f"c{i}", 463, spans[f"c{i}"]))
+        rows.extend(p.flush())
+        return p, rows, spans
+
+    def waste(rows):
+        def tok(e):
+            return e.length if isinstance(e, ChunkFragment) else 463
+
+        used = sum(tok(e) for r in rows for e in r)
+        return 100.0 * (1.0 - used / (len(rows) * L))
+
+    _, rows_off, _ = run("off")
+    packer, rows_fill, spans = run("fill")
+    assert waste(rows_off) > 8.0  # the quantized floor, unsplittable
+    assert waste(rows_fill) < 1.0, waste(rows_fill)
+    assert packer.split_count > 0
+
+    frags = {}
+    whole = []
+    for r in rows_fill:
+        assert sum(
+            e.length if isinstance(e, ChunkFragment) else 463 for e in r
+        ) <= L
+        for e in r:
+            if isinstance(e, ChunkFragment):
+                frags.setdefault(e.chunk_id, []).append(e)
+            else:
+                whole.append(e)
+    assert frags, "no chunk was split on the quantized mix"
+    split_names = set()
+    for cid, fs in frags.items():
+        fs.sort(key=lambda f: f.index)
+        split_names.add(fs[0].item)
+        assert [f.index for f in fs] == list(range(len(fs)))
+        assert all(f.count == len(fs) for f in fs)
+        assert fs[0].offset == 0 and fs[0].chunk_len == 463
+        for a, b in zip(fs, fs[1:]):
+            assert b.offset == a.offset + a.length
+        assert sum(f.length for f in fs) == 463
+        assert all(f.length >= 32 for f in fs)
+        # the property: exactly one fragment carries labels, and the gold
+        # span lies WHOLLY inside it — no cut ever bisected it
+        carriers = [f for f in fs if f.keep_labels]
+        assert len(carriers) == 1, (cid, carriers)
+        s, e = spans[fs[0].item]
+        c = carriers[0]
+        assert c.offset <= s and e < c.offset + c.length, (cid, (s, e), c)
+    # every chunk placed exactly once (whole or split, never both)
+    assert split_names.isdisjoint(set(whole))
+    assert len(split_names) + len(whole) == n
+
+
+def test_splitting_off_is_bit_identical_packer():
+    """splitting='off' must walk the EXACT historical code path: same row
+    compositions, same emission order, span argument ignored."""
+    rng = np.random.default_rng(1)
+    lengths = [int(x) for x in rng.integers(10, 100, 200)]
+
+    def run(**kw):
+        p = SequencePacker(100, open_rows=4, **kw)
+        rows = []
+        for i, n in enumerate(lengths):
+            rows.extend(p.add(i, n, (2, 4) if kw else None))
+        rows.extend(p.flush())
+        return rows
+
+    assert run() == run(splitting="off", min_fragment=5)
+
+
+def test_collate_packed_fragment_planes(tmp_path):
+    """Fragment collate: input_ids slice the parent, position_ids CONTINUE
+    at the token offset, token types inherit the parent's plane, the
+    keep_labels fragment carries the rebased span, siblings carry mask 0
+    and ignore-index spans, and the provenance planes round-trip."""
+    tok = make_tokenizer(tmp_path)
+    (parent,) = _items(tok, [30])
+    parent.start_id, parent.end_id = 20, 24  # span in the tail fragment
+    head = ChunkFragment(item=parent, chunk_id=7, offset=0, length=12,
+                         index=0, count=2, keep_labels=False, chunk_len=30)
+    tail = ChunkFragment(item=parent, chunk_id=7, offset=12, length=18,
+                         index=1, count=2, keep_labels=True, chunk_len=30)
+    (filler,) = _items(tok, [10])
+
+    inputs, labels, prov = collate_packed(
+        [[filler, head], [tail]], tok, max_seq_len=40, max_segments=3,
+        with_provenance=True,
+    )
+    # fragment token planes slice the parent exactly
+    assert inputs["input_ids"][0, 10:22].tolist() == parent.input_ids[:12]
+    assert inputs["input_ids"][1, :18].tolist() == parent.input_ids[12:30]
+    # positions continue at the fragment's offset (unsplit-chunk embedding)
+    assert inputs["position_ids"][0, 10:22].tolist() == list(range(12))
+    assert inputs["position_ids"][1, :18].tolist() == list(range(12, 30))
+    # token types: the parent's plane, sliced — _items puts the [SEP]s at
+    # the chunk END (position 28), so the head fragment is all zeros and
+    # the tail flips to 1 exactly at parent position 29 (= local 17)
+    sep_pos = parent.input_ids.index(tok.sep_token_id)
+    assert sep_pos == 28
+    assert (inputs["token_type_ids"][0, 10:22] == 0).all()
+    assert (inputs["token_type_ids"][1, :17] == 0).all()
+    assert inputs["token_type_ids"][1, 17] == 1
+    # labels: sibling masked + ignored, carrier rebased row-absolute
+    np.testing.assert_array_equal(
+        labels["segment_mask"], [[1, 0, 0], [1, 0, 0]]
+    )
+    assert labels["start_class"][0, 1] == -1  # sibling: ignore-index
+    assert labels["start_class"][1, 0] == 20 - 12  # rebased by offset
+    assert labels["end_class"][1, 0] == 24 - 12
+    assert labels["cls"][1, 0] == parent.label_id
+    # provenance planes
+    np.testing.assert_array_equal(prov["chunk_id"], [[-1, 7, -1], [7, -1, -1]])
+    np.testing.assert_array_equal(
+        prov["fragment_index"], [[0, 0, 0], [1, 0, 0]]
+    )
+    np.testing.assert_array_equal(
+        prov["token_offset"], [[0, 0, 0], [12, 0, 0]]
+    )
+    # inference collate (with_labels=False): EVERY present segment is in
+    # the packing map, fragments included (the re-merge needs them all)
+    _inputs2, seg_mask = collate_packed(
+        [[filler, head], [tail]], tok, max_seq_len=40, max_segments=3,
+        with_labels=False,
+    )
+    np.testing.assert_array_equal(seg_mask, [[1, 1, 0], [1, 0, 0]])
+
+
+def _split_loader(tmp_path, *, n=64, rows=4, pad_last=False, **kw):
+    tok = make_tokenizer(tmp_path)
+    # longer items than _loader's so rows leave holes worth filling
+    ds = VarLenDataset(tok, n, MAX_SEQ_LEN, lo=14, hi=44)
+    sampler = ShardedBatchSampler(n, rows, shuffle=True, drop_last=True, seed=0)
+    return tok, ds, PackedDataLoader(
+        ds, sampler, tok, max_seq_len=MAX_SEQ_LEN, rows_per_batch=rows,
+        n_jobs=2, pad_last=pad_last, splitting="fill", min_fragment=4, **kw,
+    )
+
+
+def test_split_loader_stats_and_accounting(tmp_path):
+    tok, ds, loader = _split_loader(tmp_path)
+    loader.set_epoch(1)
+    batches = list(loader)
+    assert batches
+    stats = loader.epoch_stats
+    assert stats["split_count"] > 0, "splitting never triggered on this mix"
+    assert stats["fragment_rows"] > 0
+    # the histogram counts every emitted fragment (heads included), so it
+    # covers at least the counted cuts
+    assert sum(stats["fragment_size_hist"].values()) >= stats["split_count"]
+    # items + dropped still partitions the epoch (label-carrier accounting)
+    assert stats["items"] + stats["dropped_items"] == 64
+    # waste strictly below the non-splitting loader on the same epoch
+    off = PackedDataLoader(
+        ds, ShardedBatchSampler(64, 4, shuffle=True, drop_last=True, seed=0),
+        tok, max_seq_len=MAX_SEQ_LEN, rows_per_batch=4, n_jobs=2,
+    )
+    off.set_epoch(1)
+    for _ in off:
+        pass
+    assert (
+        stats["padding_waste_pct"] < off.epoch_stats["padding_waste_pct"]
+    )
+    # every batch's labels stay within their fragment rows: spans are
+    # row-absolute indices into a real token (never pad, never -2)
+    for b in batches:
+        sc = b.labels["start_class"]
+        mask = b.labels["segment_mask"]
+        seg = b.inputs["segment_ids"]
+        for r, s in zip(*np.nonzero(mask)):
+            if sc[r, s] >= 0:
+                assert seg[r, sc[r, s]] == s + 1  # span inside its segment
+        assert b.provenance is not None  # provenance rides PackedBatch
+
+
+def test_split_loader_planned_steps_match_actual(tmp_path):
+    """ISSUE-11 satellite: the LR-schedule plan simulates SPLITTING too —
+    on a fully-read fixed corpus, planned == consumed exactly."""
+    tok, ds, loader = _split_loader(tmp_path)
+    planned = loader.planned_epoch_steps(1)
+    loader.set_epoch(1)
+    actual = sum(1 for _ in loader)
+    assert planned == actual
+    # and the splitting plan differs from the non-splitting one on this
+    # mix (the simulation is really split-aware, not length-only)
+    off = PackedDataLoader(
+        ds, loader.sampler, tok, max_seq_len=MAX_SEQ_LEN, rows_per_batch=4,
+        n_jobs=2,
+    )
+    assert off.planned_epoch_steps(1) >= planned
+
+
+def test_split_loader_multi_host_lockstep(tmp_path):
+    """ISSUE-11 satellite: two process-ranked SPLITTING loaders derive the
+    identical epoch plan (cuts included) from the shared length oracle —
+    same per-step shapes and segment counts, concatenated slices equal to
+    the single-process batches bit for bit, host-invariant step plan."""
+    tok = make_tokenizer(tmp_path)
+    ds = VarLenDataset(tok, 64, MAX_SEQ_LEN, lo=14, hi=44)
+
+    def loader(pi, pc):
+        sampler = ShardedBatchSampler(
+            len(ds), 8, process_index=pi, process_count=pc,
+            shuffle=True, drop_last=True, seed=0,
+        )
+        ldr = PackedDataLoader(
+            ds, sampler, tok, max_seq_len=MAX_SEQ_LEN, rows_per_batch=8,
+            n_jobs=2, splitting="fill", min_fragment=4,
+        )
+        ldr.set_epoch(1)
+        return ldr
+
+    single, p0, p1 = loader(0, 1), loader(0, 2), loader(1, 2)
+    bs, b0, b1 = list(single), list(p0), list(p1)
+    assert len(bs) == len(b0) == len(b1) >= 1
+    assert single.epoch_stats["split_count"] > 0
+    assert p0.epoch_stats["split_count"] == single.epoch_stats["split_count"]
+    for s, a, b in zip(bs, b0, b1):
+        assert (s.rows, s.segments, s.seq) == (a.rows, a.segments, a.seq)
+        assert (a.rows, a.segments, a.seq) == (b.rows, b.segments, b.seq)
+        for key in ("input_ids", "segment_ids", "position_ids"):
+            merged = np.concatenate([a.inputs[key], b.inputs[key]])
+            np.testing.assert_array_equal(merged, s.inputs[key])
+        merged_mask = np.concatenate(
+            [a.labels["segment_mask"], b.labels["segment_mask"]]
+        )
+        np.testing.assert_array_equal(merged_mask, s.labels["segment_mask"])
+        merged_start = np.concatenate(
+            [a.labels["start_class"], b.labels["start_class"]]
+        )
+        np.testing.assert_array_equal(merged_start, s.labels["start_class"])
+    assert (
+        p0.planned_epoch_steps(1)
+        == p1.planned_epoch_steps(1)
+        == single.planned_epoch_steps(1)
+    )
+
+
+def test_packed_trainer_splitting_trains_and_evals(tmp_path, caplog):
+    """End to end: a packed trainer under --pack_splitting fill trains and
+    evals with finite metrics, the loader really splits, the LR schedule
+    was sized from the split-aware plan (epoch-1 stretch warning stays
+    quiet), and the weighted meters count each example once."""
+    import logging
+
+    from ml_recipe_tpu.train import AccuracyCallback
+
+    with caplog.at_level(logging.WARNING):
+        trainer = _packed_trainer(
+            tmp_path, pack_splitting="fill", pack_min_fragment=4
+        )
+        trainer.train()
+    stats = trainer.train_dataloader.epoch_stats
+    assert stats["split_count"] > 0
+    assert stats["batches"] == trainer._planned_steps_per_epoch
+    assert "LR decay will end" not in caplog.text  # plan == consumption
+    metrics = trainer.test(1, callbacks=[AccuracyCallback()])
+    for key in ("loss", "s_acc", "c_acc"):
+        assert key in metrics and np.isfinite(metrics[key])
+    # eval counted each original example exactly once: segments across
+    # batches == dataset size (pad rows and sibling fragments excluded)
+    assert trainer.test_dataloader.epoch_stats["items"] == 20
 
 
 # ---------------------------------------------------------------------------
