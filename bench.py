@@ -143,12 +143,9 @@ def _acquire_backend(max_tries: int = 5, base_delay_s: float = 10.0,
 
     import jax
 
-    env_platforms = os.environ.get("JAX_PLATFORMS")
-    if env_platforms:
-        try:
-            jax.config.update("jax_platforms", env_platforms)
-        except Exception:  # pragma: no cover - backend already initialized
-            pass
+    from ml_recipe_tpu.utils.platform import honor_env_platform
+
+    honor_env_platform()
 
     last: BaseException | None = None
     for attempt in range(max_tries):
@@ -202,8 +199,9 @@ def _clear_backend_cache() -> None:
         from jax._src import xla_bridge
 
         xla_bridge._clear_backends()
-    except Exception:  # pragma: no cover - private API drift
-        pass
+    except Exception as e:  # pragma: no cover - private API drift
+        print(f"warning: backend cache not cleared ({e}); the retry may "
+              f"replay a cached init error", file=sys.stderr)
 
 
 def _emit_backend_failure(err: BaseException) -> int:

@@ -1,0 +1,41 @@
+"""First-party static analysis over the package's own ASTs.
+
+The hazard classes this suite guards are the ones this codebase has
+actually hit (ISSUE 12): donated-buffer reuse (the PR-8 resume-then-train
+heap corruption), host syncs and tracer leaks inside jitted bodies,
+unseeded RNG on the multi-host lockstep path, silently-swallowed broad
+exceptions (the PR-10 ``StepTimer.stop`` class), wall-clock interval
+timing, and manual lock acquire/release outside ``with``/``finally``.
+
+Public surface:
+
+- :func:`run_analysis` — run the rule suite over a file set, returns a
+  :class:`Report` (findings post-allowlist, suppression accounting).
+- :func:`iter_rules` / :func:`get_rule` — the registered rule objects
+  (id, name, severity, summary, rationale).
+- :func:`render_rule_table` — the generated markdown rule-reference
+  table embedded verbatim in README "Static analysis" (enforced by a
+  docs-consistency gate in tests/test_lint.py).
+- CLI: ``python -m ml_recipe_tpu.analysis [paths...] [--rules ...]
+  [--format text|json]`` — exit 0 clean, 1 findings, 2 engine errors.
+"""
+
+from .engine import (  # noqa: F401
+    AllowEntry,
+    EngineError,
+    Finding,
+    Report,
+    Rule,
+    default_allowlist_path,
+    default_paths,
+    get_rule,
+    iter_rules,
+    load_allowlist,
+    render_rule_table,
+    run_analysis,
+)
+
+# importing the rule modules registers their rules with the engine
+from . import rules_jax  # noqa: F401,E402
+from . import rules_determinism  # noqa: F401,E402
+from . import rules_runtime  # noqa: F401,E402

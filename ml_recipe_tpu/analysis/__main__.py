@@ -1,0 +1,130 @@
+"""CLI: ``python -m ml_recipe_tpu.analysis [paths...] [options]``.
+
+Exit codes (contract relied on by scripts/lint.sh and tier-1):
+
+- 0 — clean (no unsuppressed findings)
+- 1 — findings
+- 2 — engine error (unknown rule, unparseable file, malformed or
+  reasonless allowlist entry, internal crash) — the gate itself is
+  broken, which must never read as either "clean" or "findings"
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .engine import (
+    EngineError,
+    Report,
+    default_allowlist_path,
+    iter_rules,
+    load_allowlist,
+    render_rule_table,
+    run_analysis,
+)
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m ml_recipe_tpu.analysis",
+        description="First-party AST hazard analyzer (see README "
+                    "'Static analysis' for the rule reference).",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to scan (default: the "
+                        "ml_recipe_tpu package plus bench.py)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule IDs or names to run "
+                        "(default: all)")
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="findings output format (default: text)")
+    p.add_argument("--output", default=None, metavar="FILE",
+                   help="also write the report in --format to FILE "
+                        "(stdout keeps the text summary)")
+    p.add_argument("--allowlist", default=None, metavar="FILE",
+                   help="allowlist file (default: the packaged "
+                        "ml_recipe_tpu/analysis/allowlist)")
+    p.add_argument("--no-allowlist", action="store_true",
+                   help="run with suppressions disabled")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the registered rules and exit")
+    p.add_argument("--print-rule-table", action="store_true",
+                   help="print the markdown rule-reference table "
+                        "(the README copy must match verbatim) and exit")
+    return p
+
+
+def _render_text(report: Report) -> str:
+    lines = [f.render() for f in report.findings]
+    if report.findings:
+        lines.append(
+            f"{len(report.findings)} finding(s) in "
+            f"{report.files_scanned} files "
+            f"({len(report.suppressed)} allowlisted)."
+        )
+    else:
+        lines.append(
+            f"OK: no findings ({report.files_scanned} files, "
+            f"{len(report.rules_run)} rules, "
+            f"{len(report.suppressed)} allowlisted)."
+        )
+    for entry in report.unused_allow:
+        lines.append(
+            f"note: unused allowlist entry {entry.rule} {entry.path} "
+            f"(reason: {entry.reason})"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        for r in iter_rules():
+            print(f"{r.id} {r.name} [{r.severity}] — {r.summary}")
+        return 0
+    if args.print_rule_table:
+        print(render_rule_table(), end="")
+        return 0
+    try:
+        rules = (
+            [k for k in args.rules.split(",") if k.strip()]
+            if args.rules else None
+        )
+        allow = [] if args.no_allowlist else load_allowlist(
+            Path(args.allowlist) if args.allowlist
+            else default_allowlist_path()
+        )
+        report = run_analysis(
+            paths=[Path(p) for p in args.paths] or None,
+            rules=rules,
+            allowlist=allow,
+        )
+    except EngineError as e:
+        print(f"engine error: {e}", file=sys.stderr)
+        return 2
+    except Exception as e:  # noqa: BLE001 - anything else is also a broken
+        # gate, not a findings verdict; exit 2 keeps the contract honest
+        print(f"engine error (internal): {e!r}", file=sys.stderr)
+        return 2
+
+    payload = (
+        json.dumps(report.to_json(), indent=2) + "\n"
+        if args.format == "json" else _render_text(report)
+    )
+    if args.output:
+        out = Path(args.output)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(payload)
+        print(_render_text(report), end="")
+        if args.format == "json":
+            print(f"report written to {out}")
+    else:
+        print(payload, end="")
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,262 @@
+"""Shared AST plumbing for the analysis rules.
+
+Everything here is stdlib-``ast`` only: the analyzer must run in any
+environment that can import the package, including ones without jax.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Set, Union
+
+FunctionNode = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+ScopeNode = Union[ast.Module, ast.FunctionDef, ast.AsyncFunctionDef]
+
+# attribute projections of a traced array that are static at trace time —
+# reading them off a tracer is legal and breaks taint propagation
+STATIC_PROJECTIONS = {"shape", "ndim", "dtype", "size", "itemsize"}
+
+
+@dataclass
+class SourceFile:
+    """One parsed source file, with parent links installed on the tree."""
+
+    path: str  # root-relative posix path (as reported in findings)
+    abspath: Path
+    text: str
+    tree: ast.Module
+
+    @classmethod
+    def parse(cls, abspath: Path, relpath: str) -> "SourceFile":
+        text = abspath.read_text()
+        tree = ast.parse(text, filename=str(abspath))
+        link_parents(tree)
+        return cls(path=relpath, abspath=abspath, text=text, tree=tree)
+
+
+def link_parents(tree: ast.AST) -> None:
+    """Install ``.parent`` links so rules can walk upward."""
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.parent = node  # type: ignore[attr-defined]
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "parent", None)
+
+
+def ancestors(node: ast.AST) -> Iterator[ast.AST]:
+    cur = parent(node)
+    while cur is not None:
+        yield cur
+        cur = parent(cur)
+
+
+def enclosing_function(node: ast.AST) -> Optional[FunctionNode]:
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def enclosing_scope(node: ast.AST) -> ScopeNode:
+    """Nearest function (or the module) holding this node."""
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+            return anc
+    raise ValueError("node has no scope ancestor (parents not linked?)")
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """Render a Name/Attribute chain as ``"a.b.c"``; None for anything
+    more exotic (calls, subscripts, literals)."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal(dotted_name: str) -> str:
+    return dotted_name.rsplit(".", 1)[-1]
+
+
+def stmt_block_of(node: ast.AST):
+    """Return ``(block_list, index)`` for the statement containing
+    ``node`` — the list is the body/orelse/finalbody the statement sits
+    in, so rules can inspect siblings. None when not found."""
+    stmt = node
+    while not isinstance(stmt, ast.stmt):
+        p = parent(stmt)
+        if p is None:
+            return None
+        stmt = p
+    holder = parent(stmt)
+    if holder is None:
+        return None
+    for fname in ("body", "orelse", "finalbody", "handlers"):
+        block = getattr(holder, fname, None)
+        if isinstance(block, list) and stmt in block:
+            return block, block.index(stmt)
+    # ExceptHandler bodies live one level down
+    return None
+
+
+def in_finalbody(node: ast.AST) -> bool:
+    """True when the statement containing ``node`` is (transitively)
+    inside some ``try``'s ``finally`` block."""
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        p = parent(cur)
+        if isinstance(p, ast.Try) and isinstance(cur, ast.stmt):
+            if cur in p.finalbody:
+                return True
+        cur = p
+    return False
+
+
+def assigned_names(target: ast.AST) -> Set[str]:
+    """Dotted names (re)bound by an assignment target, including tuple
+    unpacking and starred elements."""
+    out: Set[str] = set()
+    stack = [target]
+    while stack:
+        t = stack.pop()
+        if isinstance(t, (ast.Tuple, ast.List)):
+            stack.extend(t.elts)
+        elif isinstance(t, ast.Starred):
+            stack.append(t.value)
+        else:
+            d = dotted(t)
+            if d is not None:
+                out.add(d)
+    return out
+
+
+def int_literal_set(node: ast.AST) -> Optional[Set[int]]:
+    """``0`` / ``(0, 1)`` / ``[0, 1]`` → {0, 1}; None when non-literal."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[int] = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.add(elt.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def str_literal_set(node: ast.AST) -> Optional[Set[str]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.add(elt.value)
+            else:
+                return None
+        return out
+    return None
+
+
+@dataclass
+class ScopeIndex:
+    """Per-module map of every scope to the functions defined directly in
+    it, for resolving ``jit(fwd)``-style references."""
+
+    defs_by_scope: Dict[int, Dict[str, FunctionNode]] = field(
+        default_factory=dict
+    )
+
+    @classmethod
+    def build(cls, tree: ast.Module) -> "ScopeIndex":
+        idx = cls()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Module, ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.ClassDef)):
+                local = idx.defs_by_scope.setdefault(id(node), {})
+                for child in getattr(node, "body", []):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        local[child.name] = child
+        return idx
+
+    def resolve(self, name: str, at: ast.AST) -> Optional[FunctionNode]:
+        """Look ``name`` up through the scope chain enclosing ``at``."""
+        cur: Optional[ast.AST] = at
+        while cur is not None:
+            local = self.defs_by_scope.get(id(cur))
+            if local and name in local:
+                return local[name]
+            cur = parent(cur)
+        return None
+
+
+def references_tainted(expr: ast.AST, tainted: Set[str]) -> bool:
+    """Does ``expr`` read a tainted (traced) value as a *value*?
+
+    Static projections (``x.shape``, ``x.dtype``, ``len(x)``,
+    ``x.ndim``, …) of tainted names do not count — they are concrete at
+    trace time.
+    """
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Attribute) and node.attr in STATIC_PROJECTIONS:
+            continue  # x.shape / x.dtype — static, don't descend
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id in ("len", "isinstance", "type")):
+            # len(x)/isinstance(x, T)/type(x) of a tracer are static
+            continue
+        if isinstance(node, ast.Name) and node.id in tainted:
+            return True
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def function_param_names(fn: FunctionNode) -> List[str]:
+    args = fn.args
+    names = [a.arg for a in getattr(args, "posonlyargs", [])]
+    names += [a.arg for a in args.args]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    names += [a.arg for a in args.kwonlyargs]
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return names
+
+
+def taint_function(fn: FunctionNode, static_params: Set[str]) -> Set[str]:
+    """Forward taint pass: parameters are traced; local names assigned
+    from traced expressions become traced. One pass in source order is
+    enough for the straight-line bodies this package writes."""
+    tainted: Set[str] = {
+        p for p in function_param_names(fn)
+        if p not in static_params and p != "self"
+    }
+    for node in ast.walk(fn):
+        value = None
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            value, targets = node.value, node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            value, targets = node.value, [node.target]
+        elif isinstance(node, ast.AugAssign):
+            value, targets = node.value, [node.target]
+        elif isinstance(node, ast.NamedExpr):
+            value, targets = node.value, [node.target]
+        elif isinstance(node, ast.For):
+            value, targets = node.iter, [node.target]
+        if value is not None and references_tainted(value, tainted):
+            for t in targets:
+                tainted |= {terminal(n) for n in assigned_names(t)}
+    return tainted

@@ -1,0 +1,315 @@
+"""Rule engine: registry, file loading, allowlist, report assembly.
+
+Contracts (ISSUE 12):
+
+- every rule has a stable ID (``MLA0NN``), a kebab-case name, a
+  severity, a one-line summary (what it catches) and a rationale (why
+  it bit this codebase) — the latter two feed the generated README
+  rule-reference table;
+- allowlist entries REQUIRE a written reason — a reasonless entry is an
+  :class:`EngineError`, not a silent suppression;
+- engine failures (unknown rule, unparseable file, bad allowlist) are
+  typed :class:`EngineError` so the CLI can distinguish "findings"
+  (exit 1) from "the gate itself is broken" (exit 2).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .astutils import SourceFile
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+
+
+class EngineError(Exception):
+    """The analyzer itself failed (bad config, unparseable input) — the
+    CLI maps this to exit code 2, distinct from findings (exit 1)."""
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str       # "MLA005"
+    name: str       # "swallowed-exception"
+    severity: str   # "error" | "warning"
+    path: str       # root-relative posix path
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule} [{self.name}] "
+                f"{self.message}")
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule, "name": self.name, "severity": self.severity,
+            "path": self.path, "line": self.line, "message": self.message,
+        }
+
+
+@dataclass
+class Context:
+    """What a rule sees: the parsed file set plus the scan root."""
+
+    root: Path
+    files: List[SourceFile]
+
+    def by_path(self) -> Dict[str, SourceFile]:
+        return {f.path: f for f in self.files}
+
+
+RuleFn = Callable[[Context], Iterable[Finding]]
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    severity: str
+    summary: str     # what it catches (rule-reference table column)
+    rationale: str   # why it bit us (rule-reference table column)
+    check: RuleFn
+
+    def finding(self, src: SourceFile, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=self.id, name=self.name, severity=self.severity,
+            path=src.path, line=getattr(node, "lineno", 0), message=message,
+        )
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(rule_id: str, name: str, severity: str, summary: str,
+             rationale: str) -> Callable[[RuleFn], RuleFn]:
+    """Decorator: register ``fn`` as the check for a rule."""
+
+    def deco(fn: RuleFn) -> RuleFn:
+        if rule_id in _REGISTRY:
+            raise EngineError(f"duplicate rule id {rule_id}")
+        _REGISTRY[rule_id] = Rule(
+            id=rule_id, name=name, severity=severity, summary=summary,
+            rationale=rationale, check=fn,
+        )
+        return fn
+
+    return deco
+
+
+def iter_rules() -> List[Rule]:
+    return [r for _, r in sorted(_REGISTRY.items())]
+
+
+def get_rule(key: str) -> Rule:
+    """Look a rule up by ID (``MLA005``) or name (``swallowed-exception``),
+    case-insensitive."""
+    k = key.strip().lower()
+    for rule in _REGISTRY.values():
+        if rule.id.lower() == k or rule.name.lower() == k:
+            return rule
+    raise EngineError(
+        f"unknown rule {key!r} (known: "
+        + ", ".join(f"{r.id}/{r.name}" for r in iter_rules()) + ")"
+    )
+
+
+# -- allowlist ---------------------------------------------------------------
+
+@dataclass(frozen=True)
+class AllowEntry:
+    rule: str
+    path: str
+    reason: str
+    line: int  # line in the allowlist file, for error reporting
+
+
+def default_allowlist_path() -> Path:
+    return Path(__file__).resolve().parent / "allowlist"
+
+
+def load_allowlist(path: Path) -> List[AllowEntry]:
+    """Parse ``<RULE> <path> reason: <text>`` lines.
+
+    A reason is REQUIRED: an allowlist without written justification is
+    how suppressions rot into folklore, which is the failure mode this
+    whole subsystem exists to end.
+    """
+    if not path.exists():
+        raise EngineError(f"allowlist file not found: {path}")
+    entries: List[AllowEntry] = []
+    for lineno, raw in enumerate(path.read_text().splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split(None, 2)
+        if len(parts) < 3 or not parts[2].startswith("reason:"):
+            raise EngineError(
+                f"{path}:{lineno}: malformed allowlist entry (expected "
+                f"'<RULE-ID> <path> reason: <text>'): {raw!r}"
+            )
+        rule_key, rel, reason = parts
+        reason = reason[len("reason:"):].strip()
+        if not reason:
+            raise EngineError(
+                f"{path}:{lineno}: allowlist entry for {rule_key} {rel} has "
+                f"an EMPTY reason — a suppression without a written reason "
+                f"is not allowed"
+            )
+        rule = get_rule(rule_key)  # validates the id
+        entries.append(AllowEntry(rule=rule.id, path=rel, reason=reason,
+                                  line=lineno))
+    return entries
+
+
+# -- file loading ------------------------------------------------------------
+
+def default_paths(root: Optional[Path] = None) -> List[Path]:
+    """The gate's default scan surface: the package plus bench.py —
+    exactly what the shell/grep gates this engine absorbs covered."""
+    root = root or _REPO_ROOT
+    out = [root / "ml_recipe_tpu"]
+    bench = root / "bench.py"
+    if bench.exists():
+        out.append(bench)
+    return out
+
+
+def _collect_files(paths: Sequence[Path], root: Path) -> List[SourceFile]:
+    seen: Dict[Path, None] = {}
+    for p in paths:
+        p = Path(p)
+        if not p.is_absolute():
+            # caller's cwd first (what a CLI user means by `src/foo.py`),
+            # scan root as the fallback (what programmatic callers pass)
+            cand = (Path.cwd() / p).resolve()
+            p = cand if cand.exists() else (root / p).resolve()
+        if p.is_dir():
+            for sub in sorted(p.rglob("*.py")):
+                if "__pycache__" in sub.parts:
+                    continue
+                seen.setdefault(sub.resolve())
+        elif p.suffix == ".py" and p.exists():
+            seen.setdefault(p.resolve())
+        else:
+            raise EngineError(f"not a python file or directory: {p}")
+    files: List[SourceFile] = []
+    for abspath in seen:
+        try:
+            rel = abspath.relative_to(root).as_posix()
+        except ValueError:
+            rel = abspath.as_posix()
+        try:
+            files.append(SourceFile.parse(abspath, rel))
+        except SyntaxError as e:
+            raise EngineError(f"cannot parse {rel}: {e}") from e
+    return files
+
+
+# -- run ---------------------------------------------------------------------
+
+@dataclass
+class Report:
+    findings: List[Finding]
+    suppressed: List[Tuple[Finding, AllowEntry]]
+    unused_allow: List[AllowEntry]
+    files_scanned: int
+    rules_run: List[str]
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+    def to_json(self) -> dict:
+        return {
+            "clean": self.clean,
+            "files_scanned": self.files_scanned,
+            "rules_run": self.rules_run,
+            "findings": [f.to_json() for f in self.findings],
+            "suppressed": [
+                {**f.to_json(), "allow_reason": a.reason}
+                for f, a in self.suppressed
+            ],
+            "unused_allowlist_entries": [
+                {"rule": a.rule, "path": a.path, "reason": a.reason}
+                for a in self.unused_allow
+            ],
+        }
+
+
+def run_analysis(
+    paths: Optional[Sequence[Path]] = None,
+    rules: Optional[Sequence[str]] = None,
+    allowlist: Optional[Sequence[AllowEntry]] = None,
+    allowlist_path: Optional[Path] = None,
+    root: Optional[Path] = None,
+) -> Report:
+    """Run the (selected) rule suite over ``paths``.
+
+    ``allowlist=None`` loads the packaged default file; pass ``[]`` to
+    run with suppressions disabled (fixture tests do).
+    """
+    root = Path(root) if root is not None else _REPO_ROOT
+    selected = (
+        [get_rule(k) for k in rules] if rules is not None else iter_rules()
+    )
+    if not selected:
+        raise EngineError("no rules selected")
+    if allowlist is None:
+        allowlist = load_allowlist(allowlist_path or default_allowlist_path())
+    ctx = Context(root=root, files=_collect_files(
+        list(paths) if paths else default_paths(root), root,
+    ))
+
+    raw: List[Finding] = []
+    for rule in selected:
+        try:
+            raw.extend(rule.check(ctx))
+        except EngineError:
+            raise
+        except Exception as e:
+            raise EngineError(f"rule {rule.id} crashed: {e!r}") from e
+
+    findings: List[Finding] = []
+    suppressed: List[Tuple[Finding, AllowEntry]] = []
+    used: set = set()
+    by_key: Dict[Tuple[str, str], AllowEntry] = {
+        (a.rule, a.path): a for a in allowlist
+    }
+    for f in sorted(raw, key=lambda f: (f.path, f.line, f.rule)):
+        entry = by_key.get((f.rule, f.path))
+        if entry is not None:
+            suppressed.append((f, entry))
+            used.add((entry.rule, entry.path))
+        else:
+            findings.append(f)
+    selected_ids = {r.id for r in selected}
+    unused = [
+        a for a in allowlist
+        if a.rule in selected_ids and (a.rule, a.path) not in used
+    ]
+    return Report(
+        findings=findings, suppressed=suppressed, unused_allow=unused,
+        files_scanned=len(ctx.files),
+        rules_run=[r.id for r in selected],
+    )
+
+
+# -- docs --------------------------------------------------------------------
+
+def render_rule_table() -> str:
+    """The markdown rule-reference table embedded in README "Static
+    analysis"; tests/test_lint.py asserts the README copy matches this
+    output verbatim (regenerate with ``--print-rule-table``)."""
+    rows = [
+        "| ID | Rule | Severity | Catches | Why it bit us |",
+        "|----|------|----------|---------|---------------|",
+    ]
+    for r in iter_rules():
+        rows.append(
+            f"| `{r.id}` | `{r.name}` | {r.severity} | {r.summary} "
+            f"| {r.rationale} |"
+        )
+    return "\n".join(rows) + "\n"

@@ -1,0 +1,146 @@
+"""MLA004: unseeded nondeterminism on the multi-host lockstep path.
+
+Bucketing, packing, and chunk splitting only work multi-host because
+every host derives the IDENTICAL epoch plan from the seeded length
+oracle (`data/packing.oracle_epoch_meta`, ORACLE_SEED-pinned per
+(epoch, index) RNG). One draw from the process-global `random` /
+`np.random` state anywhere on that path makes plans diverge per host —
+and the failure mode is not a crash but silently inconsistent
+collectives.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Iterable, List, Set
+
+from . import astutils as A
+from .engine import Context, Finding, register
+
+# the lockstep path roots: these files plus everything they import from
+# inside the package are held to seeded-Generator discipline
+LOCKSTEP_ROOTS = (
+    "ml_recipe_tpu/data/packing.py",
+    "ml_recipe_tpu/data/bucketing.py",
+    "ml_recipe_tpu/data/chunking.py",
+)
+
+# explicit-seed constructors / seed plumbing types are the SANCTIONED way
+_NP_ALLOWED = {
+    "default_rng", "Generator", "SeedSequence", "RandomState",
+    "PCG64", "Philox", "SFC64", "MT19937", "BitGenerator",
+}
+_PY_ALLOWED = {"Random", "SystemRandom"}
+
+
+def _package_module_to_path(module: str, level: int, src_path: str,
+                            known: Set[str]) -> List[str]:
+    """Resolve an import statement to root-relative candidate file paths
+    within the scanned set (absolute `ml_recipe_tpu.x.y` and relative
+    `from .. import z` forms)."""
+    if level == 0:
+        if not module.startswith("ml_recipe_tpu"):
+            return []
+        base = module.replace(".", "/")
+    else:
+        pkg_dir = Path(src_path).parent
+        for _ in range(level - 1):
+            pkg_dir = pkg_dir.parent
+        base = (pkg_dir / module.replace(".", "/")).as_posix() if module \
+            else pkg_dir.as_posix()
+    out = []
+    for cand in (f"{base}.py", f"{base}/__init__.py"):
+        if cand in known:
+            out.append(cand)
+    return out
+
+
+def _lockstep_files(ctx: Context) -> List:
+    by_path = ctx.by_path()
+    known = set(by_path)
+    todo = [p for p in LOCKSTEP_ROOTS if p in known]
+    seen: Set[str] = set()
+    while todo:
+        path = todo.pop()
+        if path in seen:
+            continue
+        seen.add(path)
+        src = by_path[path]
+        for node in ast.walk(src.tree):
+            mods: List[str] = []
+            if isinstance(node, ast.Import):
+                mods = [a.name for a in node.names]
+                level = 0
+            elif isinstance(node, ast.ImportFrom):
+                mods = [node.module or ""]
+                level = node.level
+                # `from .x import y` may name modules in the import list
+                if level or (node.module or "").startswith("ml_recipe_tpu"):
+                    prefix = node.module + "." if node.module else ""
+                    mods += [prefix + a.name for a in node.names]
+            else:
+                continue
+            for mod in mods:
+                todo.extend(
+                    _package_module_to_path(mod, level, path, known)
+                )
+    return [by_path[p] for p in sorted(seen)]
+
+
+@register(
+    "MLA004", "unseeded-randomness", "error",
+    summary=(
+        "a draw from the process-global `random` / `np.random` state in "
+        "the multi-host lockstep modules (`data/packing.py`, "
+        "`data/bucketing.py`, `data/chunking.py` and their package "
+        "imports) — only explicitly seeded Generators are allowed there"
+    ),
+    rationale=(
+        "multi-host bucketing/packing (PR 8/11) only stays in lockstep "
+        "because every host derives identical plans from the seeded "
+        "length oracle; one global-RNG draw desyncs the hosts' plans and "
+        "the collectives fail silently, not loudly"
+    ),
+)
+def check_unseeded_randomness(ctx: Context) -> Iterable[Finding]:
+    from .engine import get_rule
+
+    rule = get_rule("MLA004")
+    for src in _lockstep_files(ctx):
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "random":
+                bad = [a.name for a in node.names
+                       if a.name not in _PY_ALLOWED]
+                if bad:
+                    yield rule.finding(
+                        src, node,
+                        f"importing global-state RNG function(s) "
+                        f"{', '.join(bad)} from `random` on the lockstep "
+                        f"path — construct a seeded `random.Random(seed)` "
+                        f"or `np.random.default_rng(seed)` instead",
+                    )
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            d = A.dotted(node.func)
+            if d is None:
+                continue
+            if d.startswith("np.random.") or d.startswith("numpy.random."):
+                fn = A.terminal(d)
+                if fn not in _NP_ALLOWED:
+                    yield rule.finding(
+                        src, node,
+                        f"`{d}()` draws from numpy's process-global RNG on "
+                        f"the multi-host lockstep path — derive from a "
+                        f"seeded `np.random.default_rng(...)`",
+                    )
+            elif d.startswith("random.") and d.count(".") == 1:
+                fn = A.terminal(d)
+                if fn not in _PY_ALLOWED:
+                    yield rule.finding(
+                        src, node,
+                        f"`{d}()` draws from the process-global `random` "
+                        f"state on the multi-host lockstep path — use a "
+                        f"seeded `random.Random(seed)` instance",
+                    )

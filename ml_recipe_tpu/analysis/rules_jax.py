@@ -1,0 +1,365 @@
+"""JAX-specific hazard rules: donated-buffer reuse, host syncs inside
+jitted bodies, tracer-leaking Python control flow.
+
+These are heuristic AST passes, deliberately scoped to the patterns this
+package writes (module-local ``jax.jit`` wrapping, named step callables
+stored on ``self``) — precision over recall, with the allowlist as the
+escape hatch for the cases the heuristics misjudge.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from . import astutils as A
+from .engine import Context, Finding, register
+
+_JIT_NAMES = {"jit", "jax.jit"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    d = A.dotted(node)
+    return d in _JIT_NAMES
+
+
+def _jit_call(node: ast.AST) -> Optional[ast.Call]:
+    """Return the Call node when ``node`` is ``jit(...)`` / ``jax.jit(...)``."""
+    if isinstance(node, ast.Call) and _is_jit_ref(node.func):
+        return node
+    return None
+
+
+def _static_param_spec(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums |= A.int_literal_set(kw.value) or set()
+        elif kw.arg == "static_argnames":
+            names |= A.str_literal_set(kw.value) or set()
+    return nums, names
+
+
+def _donated_positions(call: ast.Call) -> Optional[Set[int]]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return A.int_literal_set(kw.value)
+    return None
+
+
+# -- MLA001 donated-buffer-reuse --------------------------------------------
+
+@register(
+    "MLA001", "donated-buffer-reuse", "error",
+    summary=(
+        "a value passed at a `donate_argnums` position of a jitted step is "
+        "read again later in the same scope without being rebound — the "
+        "buffer was handed to XLA and may be freed or aliased"
+    ),
+    rationale=(
+        "PR 8: the donated resume-then-train step read a param buffer that "
+        "plain `device_put` had let die — every multi-device CPU "
+        "resume-then-train heap-corrupted"
+    ),
+)
+def check_donated_reuse(ctx: Context) -> Iterable[Finding]:
+    from .engine import get_rule
+
+    rule = get_rule("MLA001")
+    for src in ctx.files:
+        # pass A: donating callables bound to names, and builder functions
+        # whose return value is a donating jit
+        donators: Dict[str, Set[int]] = {}       # terminal name -> positions
+        builder_fns: Dict[str, Set[int]] = {}    # function name -> positions
+        for node in ast.walk(src.tree):
+            call = _jit_call(node)
+            if call is None:
+                continue
+            positions = _donated_positions(call)
+            if not positions:
+                continue
+            p = A.parent(call)
+            if isinstance(p, ast.Assign):
+                names: Set[str] = set()
+                for t in p.targets:
+                    names |= A.assigned_names(t)
+                for name in names:
+                    donators[A.terminal(name)] = positions
+            elif isinstance(p, ast.Return):
+                fn = A.enclosing_function(p)
+                if fn is not None:
+                    builder_fns[fn.name] = positions
+        # pass B: names bound from builder calls
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            if isinstance(v, ast.Call):
+                d = A.dotted(v.func)
+                if d is not None and A.terminal(d) in builder_fns:
+                    for name in A.assigned_names(node.targets[0]):
+                        donators[A.terminal(name)] = builder_fns[A.terminal(d)]
+        if not donators:
+            continue
+        # pass C: call sites — donated args must be rebound before any
+        # further read in the enclosing scope
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = A.dotted(node.func)
+            if d is None or A.terminal(d) not in donators:
+                continue
+            positions = donators[A.terminal(d)]
+            for pos in sorted(positions):
+                if pos >= len(node.args):
+                    continue
+                arg_name = A.dotted(node.args[pos])
+                if arg_name is None:
+                    continue
+                bad_line = _read_after_donation(node, arg_name)
+                if bad_line is not None:
+                    yield rule.finding(
+                        src, node,
+                        f"`{arg_name}` is donated to `{A.terminal(d)}` "
+                        f"(donate_argnums position {pos}) but read again at "
+                        f"line {bad_line} without being rebound — the donated "
+                        f"buffer may have been freed or aliased by XLA",
+                    )
+
+
+def _read_after_donation(call: ast.Call, arg_name: str) -> Optional[int]:
+    """First line after ``call`` where ``arg_name`` is read with no
+    rebinding in between (line-ordered approximation within the enclosing
+    scope)."""
+    scope = A.enclosing_scope(call)
+    call_line = call.end_lineno or call.lineno
+    rebinds: List[int] = []
+    reads: List[int] = []
+    for node in ast.walk(scope):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if arg_name in A.assigned_names(t):
+                    rebinds.append(node.lineno)
+        elif isinstance(node, ast.For):
+            if arg_name in A.assigned_names(node.target):
+                rebinds.append(node.lineno)
+        elif A.dotted(node) == arg_name and isinstance(
+                getattr(node, "ctx", None), ast.Load):
+            # skip the donation argument itself
+            if not (node.lineno >= call.lineno and
+                    (node.end_lineno or node.lineno) <= call_line):
+                reads.append(node.lineno)
+    # the assignment consuming the call's result rebinds on the call line
+    p = A.parent(call)
+    if isinstance(p, ast.Assign) and any(
+            arg_name in A.assigned_names(t) for t in p.targets):
+        rebinds.append(call_line)
+    for read_line in sorted(reads):
+        if read_line <= call_line:
+            continue
+        if not any(call_line <= rb <= read_line for rb in rebinds):
+            return read_line
+    return None
+
+
+# -- jitted-body discovery (shared by MLA002 / MLA003) -----------------------
+
+def _jitted_functions(src) -> Dict[int, Tuple[A.FunctionNode, Set[str],
+                                              Set[str]]]:
+    """Map id(fn) -> (fn, static_param_names, tainted_names) for every
+    function whose body is traced: decorated with jit, wrapped by a
+    ``jit(f)`` call, reachable from a traced body via a direct same-module
+    call, or nested inside one.
+
+    Memoized per SourceFile — MLA002 and MLA003 share the discovery and
+    taint pass, which dominate the analysis cost.
+    """
+    cached = getattr(src, "_jit_map", None)
+    if cached is not None:
+        return cached
+    idx = A.ScopeIndex.build(src.tree)
+    marked: Dict[int, Tuple[A.FunctionNode, Set[str]]] = {}
+
+    def mark(fn: A.FunctionNode, statics: Set[str]) -> None:
+        if id(fn) not in marked:
+            marked[id(fn)] = (fn, statics)
+
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                if _is_jit_ref(deco):
+                    mark(node, set())
+                elif isinstance(deco, ast.Call):
+                    if _is_jit_ref(deco.func):
+                        nums, names = _static_param_spec(deco)
+                        mark(node, _static_names(node, nums, names))
+                    elif (A.dotted(deco.func) in _PARTIAL_NAMES and deco.args
+                          and _is_jit_ref(deco.args[0])):
+                        nums, names = _static_param_spec(deco)
+                        mark(node, _static_names(node, nums, names))
+        call = _jit_call(node)
+        if call is not None and call.args:
+            first = call.args[0]
+            if isinstance(first, ast.Name):
+                fn = idx.resolve(first.id, call)
+                if fn is not None:
+                    nums, names = _static_param_spec(call)
+                    mark(fn, _static_names(fn, nums, names))
+
+    # transitive closure: direct same-module calls from traced bodies, and
+    # functions defined inside traced bodies (closures traced with them)
+    changed = True
+    while changed:
+        changed = False
+        for fn, _statics in list(marked.values()):
+            for node in ast.walk(fn):
+                target: Optional[A.FunctionNode] = None
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Name)):
+                    target = idx.resolve(node.func.id, node)
+                elif (isinstance(node, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+                      and node is not fn):
+                    target = node
+                if target is not None and id(target) not in marked:
+                    marked[id(target)] = (target, set())
+                    changed = True
+    out = {
+        key: (fn, statics, A.taint_function(fn, statics))
+        for key, (fn, statics) in marked.items()
+    }
+    src._jit_map = out
+    return out
+
+
+def _static_names(fn: A.FunctionNode, nums: Set[int],
+                  names: Set[str]) -> Set[str]:
+    params = A.function_param_names(fn)
+    out = set(names)
+    for i in nums:
+        if 0 <= i < len(params):
+            out.add(params[i])
+    return out
+
+
+# -- MLA002 host-sync-in-jit -------------------------------------------------
+
+_HOST_CONVERTERS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array"}
+
+
+@register(
+    "MLA002", "host-sync-in-jit", "error",
+    summary=(
+        "`.item()`, `float()`/`int()`/`bool()`, `np.asarray`, or `print` "
+        "applied to a traced value inside a jit-traced body — a host "
+        "sync/transfer that either fails to trace or silently pins the "
+        "device stream"
+    ),
+    rationale=(
+        "the serving engine and trainer steps are compiled once and "
+        "replayed; one stray host pull inside the traced body turns into a "
+        "per-step device sync (or a ConcretizationTypeError at trace time) "
+        "— use `jax.debug.print` / keep host work outside the step"
+    ),
+)
+def check_host_sync_in_jit(ctx: Context) -> Iterable[Finding]:
+    from .engine import get_rule
+
+    rule = get_rule("MLA002")
+    for src in ctx.files:
+        for fn, _statics, tainted in _jitted_functions(src).values():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                # nested defs are marked (and walked) in their own right —
+                # skip their bodies here to avoid double reports
+                if A.enclosing_function(node) is not fn:
+                    continue
+                d = A.dotted(node.func)
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item"
+                        and A.references_tainted(node.func.value, tainted)):
+                    yield rule.finding(
+                        src, node,
+                        "`.item()` on a traced value inside a jitted body "
+                        "forces a device→host sync (fails under trace)",
+                    )
+                elif (d in _HOST_CONVERTERS and node.args
+                      and A.references_tainted(node.args[0], tainted)):
+                    yield rule.finding(
+                        src, node,
+                        f"`{d}` on a traced value inside a jitted body pulls "
+                        "the array to host — use jnp ops on the tracer",
+                    )
+                elif (isinstance(node.func, ast.Name)
+                      and node.func.id in ("float", "int", "bool")
+                      and node.args
+                      and A.references_tainted(node.args[0], tainted)):
+                    yield rule.finding(
+                        src, node,
+                        f"`{node.func.id}()` on a traced value inside a "
+                        "jitted body concretizes the tracer "
+                        "(ConcretizationTypeError or silent host sync)",
+                    )
+                elif (isinstance(node.func, ast.Name)
+                      and node.func.id == "print"
+                      and any(A.references_tainted(a, tainted)
+                              for a in node.args)):
+                    yield rule.finding(
+                        src, node,
+                        "`print` of a traced value inside a jitted body "
+                        "prints the tracer once at trace time — use "
+                        "`jax.debug.print`",
+                    )
+
+
+# -- MLA003 tracer-leak-control-flow ----------------------------------------
+
+def _is_none_check(test: ast.AST) -> bool:
+    return (isinstance(test, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops)
+            and all(isinstance(c, ast.Constant) and c.value is None
+                    for c in test.comparators))
+
+
+@register(
+    "MLA003", "tracer-leak-control-flow", "error",
+    summary=(
+        "Python `if`/`while` branching on a traced value inside a "
+        "jit-traced body — the branch is baked in at trace time "
+        "(`is None` checks and shape/dtype tests are exempt)"
+    ),
+    rationale=(
+        "a data-dependent Python branch inside a traced step either raises "
+        "ConcretizationTypeError or silently compiles only one arm — the "
+        "loss-scale finite-check path uses `jnp.where`/`lax.cond` for "
+        "exactly this reason"
+    ),
+)
+def check_tracer_leak(ctx: Context) -> Iterable[Finding]:
+    from .engine import get_rule
+
+    rule = get_rule("MLA003")
+    for src in ctx.files:
+        for fn, _statics, tainted in _jitted_functions(src).values():
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                if A.enclosing_function(node) is not fn:
+                    continue
+                test = node.test
+                if _is_none_check(test):
+                    continue
+                if A.references_tainted(test, tainted):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield rule.finding(
+                        src, node,
+                        f"Python `{kind}` on a traced value inside a jitted "
+                        "body — the branch is resolved once at trace time; "
+                        "use `jnp.where` / `jax.lax.cond` / "
+                        "`jax.lax.while_loop`",
+                    )

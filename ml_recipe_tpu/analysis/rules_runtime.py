@@ -1,0 +1,243 @@
+"""Runtime-discipline rules: exception swallowing, wall-clock intervals,
+manual lock handling.
+
+MLA005 absorbs scripts/check_bare_except.sh (the shell script is now a
+thin wrapper over this rule) and generalizes it: a broad handler that
+neither re-raises, logs, returns, nor mutates state is a silent
+swallow. MLA006 absorbs the old `time.time()` grep in tests/test_lint.py.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from . import astutils as A
+from .engine import Context, Finding, register
+
+# -- MLA005 swallowed-exception ---------------------------------------------
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, (ast.Name, ast.Attribute)):
+        d = A.dotted(t)
+        return d is not None and A.terminal(d) in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(
+            (d := A.dotted(e)) is not None and A.terminal(d) in _BROAD
+            for e in t.elts
+        )
+    return False
+
+
+def _body_swallows(body: List[ast.stmt]) -> bool:
+    """True when the handler body does NOTHING with the exception: only
+    `pass`, bare constants (docstrings/`...`), `continue`, or `break`.
+    Any raise, return, assignment, or call (logging, cleanup, state) in
+    the body — however nested — counts as handling."""
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Raise, ast.Return, ast.Assign,
+                                 ast.AugAssign, ast.AnnAssign, ast.Call,
+                                 ast.NamedExpr, ast.Yield, ast.YieldFrom,
+                                 ast.Delete, ast.Global, ast.Nonlocal)):
+                return False
+    return True
+
+
+@register(
+    "MLA005", "swallowed-exception", "error",
+    summary=(
+        "a bare `except:` (always — it eats KeyboardInterrupt/SystemExit), "
+        "or an `except Exception`/`except BaseException` whose body "
+        "neither re-raises, logs, returns a fallback, nor sets state"
+    ),
+    rationale=(
+        "PR 10 found `StepTimer.stop` swallowing EVERY exception around "
+        "`block_until_ready` — device errors surfaced as silently-wrong "
+        "timings; and a bare except turns the SIGTERM-to-checkpoint path, "
+        "the watchdog abort, and fault drills into no-ops"
+    ),
+)
+def check_swallowed_exception(ctx: Context) -> Iterable[Finding]:
+    from .engine import get_rule
+
+    rule = get_rule("MLA005")
+    for src in ctx.files:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield rule.finding(
+                    src, node,
+                    "bare `except:` swallows KeyboardInterrupt/SystemExit — "
+                    "catch `Exception` (or narrower)",
+                )
+                continue
+            if _is_broad_handler(node) and _body_swallows(node.body):
+                yield rule.finding(
+                    src, node,
+                    "broad exception handler silently swallows: the body "
+                    "neither re-raises, logs, returns a fallback, nor sets "
+                    "state — at minimum log at debug level, or narrow the "
+                    "exception type",
+                )
+
+
+# -- MLA006 wall-clock-interval ---------------------------------------------
+
+@register(
+    "MLA006", "wall-clock-interval", "error",
+    summary=(
+        "`time.time()` (or `from time import time`) — the wall clock "
+        "jumps under NTP slew; intervals must use `time.perf_counter()`; "
+        "genuine event stamps get an allowlist entry with a reason"
+    ),
+    rationale=(
+        "step timings feed the /metrics wall-time breakdown and the "
+        "slow-step anomaly baseline (PR 10) — a wall-clock jump poisons "
+        "both silently; only `train/writer.py`'s TensorBoard event "
+        "stamps legitimately want wall time"
+    ),
+)
+def check_wall_clock(ctx: Context) -> Iterable[Finding]:
+    from .engine import get_rule
+
+    rule = get_rule("MLA006")
+    for src in ctx.files:
+        # names `time.time` is bound to via `from time import time [as x]`
+        local_names: Set[str] = set()
+        for n in ast.walk(src.tree):
+            if isinstance(n, ast.ImportFrom) and n.module == "time":
+                for a in n.names:
+                    if a.name == "time":
+                        local_names.add(a.asname or a.name)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = A.dotted(node.func)
+            if d == "time.time" or d in local_names:
+                yield rule.finding(
+                    src, node,
+                    "`time.time()` used where an interval clock belongs — "
+                    "use `time.perf_counter()` (or allowlist a genuine "
+                    "wall-clock event stamp with a reason)",
+                )
+
+
+# -- MLA007 lock-discipline --------------------------------------------------
+
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+}
+
+
+def _lock_names(src) -> Set[str]:
+    """Terminal names bound to threading.Lock/RLock/Condition objects
+    (both locals and `self._lock = ...` attributes)."""
+    names: Set[str] = set()
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (isinstance(node.value, ast.Call)
+                and A.dotted(node.value.func) in _LOCK_CTORS):
+            continue
+        for t in node.targets:
+            for d in A.assigned_names(t):
+                names.add(A.terminal(d))
+    return names
+
+
+def _receiver(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        return A.dotted(call.func.value)
+    return None
+
+
+def _next_sibling_releases(call: ast.Call, recv: str) -> bool:
+    """`lock.acquire()` immediately followed by `try: ... finally:
+    lock.release()` is the one manual pattern that is exception-safe."""
+    loc = A.stmt_block_of(call)
+    if loc is None:
+        return False
+    block, idx = loc
+    if idx + 1 >= len(block):
+        return False
+    nxt = block[idx + 1]
+    return isinstance(nxt, ast.Try) and _releases_in(nxt.finalbody, recv)
+
+
+def _releases_in(stmts: List[ast.stmt], recv: str) -> bool:
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "release"
+                    and A.dotted(node.func.value) == recv):
+                return True
+    return False
+
+
+def _inside_try_with_final_release(call: ast.Call, recv: str) -> bool:
+    for anc in A.ancestors(call):
+        if isinstance(anc, ast.Try) and _releases_in(anc.finalbody, recv):
+            return True
+    return False
+
+
+@register(
+    "MLA007", "lock-discipline", "error",
+    summary=(
+        "a `threading.Lock`/`RLock`/`Condition` acquired outside `with` "
+        "and not paired with a `finally` release, or released on a "
+        "non-`finally` path — an exception between acquire and release "
+        "leaves the lock held forever"
+    ),
+    rationale=(
+        "the serving cache's single-flight admission and the batcher "
+        "condition variable are correct only because every hold is a "
+        "`with` block — one manual acquire that unwinds on an exception "
+        "wedges the whole serving plane, with no crash to point at"
+    ),
+)
+def check_lock_discipline(ctx: Context) -> Iterable[Finding]:
+    from .engine import get_rule
+
+    rule = get_rule("MLA007")
+    for src in ctx.files:
+        locks = _lock_names(src)
+        if not locks:
+            continue
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            recv = _receiver(node)
+            if recv is None or A.terminal(recv) not in locks:
+                continue
+            if node.func.attr == "acquire":
+                if (_next_sibling_releases(node, recv)
+                        or _inside_try_with_final_release(node, recv)):
+                    continue
+                yield rule.finding(
+                    src, node,
+                    f"`{recv}.acquire()` without a guaranteed release "
+                    f"(`with {recv}:` or an immediately-following "
+                    f"`try/finally: {recv}.release()`) — an exception here "
+                    f"leaves the lock held",
+                )
+            elif node.func.attr == "release":
+                if A.in_finalbody(node):
+                    continue
+                yield rule.finding(
+                    src, node,
+                    f"`{recv}.release()` on a non-`finally` path — an "
+                    f"exception on the success path skips the release; "
+                    f"use `with {recv}:`",
+                )

@@ -18,6 +18,7 @@ feeds only its slice, SURVEY.md §7).
 
 from __future__ import annotations
 
+import logging
 import re
 from typing import Dict, NamedTuple, Optional
 
@@ -25,6 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
@@ -201,8 +204,11 @@ def opt_state_bytes_per_chip(opt_state) -> int:
         if sharding is not None:
             try:
                 shape = tuple(sharding.shard_shape(shape))
-            except Exception:  # noqa: BLE001 - exotic sharding: count full
-                pass
+            except Exception as e:  # noqa: BLE001 - exotic sharding
+                logger.debug(
+                    "shard_shape unavailable for %s (%s); counting the "
+                    "full shape", type(sharding).__name__, e,
+                )
         total += int(np.prod(shape or (1,), dtype=np.int64)) * itemsize
     return total
 

@@ -12,7 +12,10 @@ same as the documented in-process recipe.
 
 from __future__ import annotations
 
+import logging
 import os
+
+logger = logging.getLogger(__name__)
 
 
 def honor_env_platform() -> None:
@@ -29,5 +32,8 @@ def honor_env_platform() -> None:
 
     try:
         jax.config.update("jax_platforms", env_platforms)
-    except Exception:  # pragma: no cover - backend already initialized
-        pass
+    except Exception as e:  # pragma: no cover - backend already initialized
+        logger.debug(
+            "JAX_PLATFORMS=%r not re-asserted (backend already "
+            "initialized): %s", env_platforms, e,
+        )

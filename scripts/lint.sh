@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Full static-analysis gate: run every analyzer rule over the package +
+# bench.py and persist a JSON findings artifact.
+#
+# Usage: scripts/lint.sh [extra analyzer args...]
+#   LINT_JSON_OUT overrides the artifact path
+#     (default artifacts/lint/analysis.json).
+#
+# Exit codes (the analyzer's contract, passed through):
+#   0 = clean, 1 = findings, 2 = engine error (the gate itself broke —
+#   never conflate with either verdict).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+out="${LINT_JSON_OUT:-artifacts/lint/analysis.json}"
+python -m ml_recipe_tpu.analysis --format json --output "$out" "$@"
+exit $?

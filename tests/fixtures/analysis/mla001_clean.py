@@ -1,0 +1,23 @@
+"""MLA001 clean twin: every donated argument is rebound by the call's
+own consuming assignment before any further read."""
+import jax
+
+
+def build_step():
+    def step(state, batch):
+        return state + batch
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def train(state, batch):
+    step = build_step()
+    state = step(state, batch)  # rebound: the fresh buffer takes the name
+    return state.mean()
+
+
+def loop(state, batches):
+    step = build_step()
+    for batch in batches:
+        state = step(state, batch)
+    return state

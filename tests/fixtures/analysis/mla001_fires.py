@@ -1,0 +1,22 @@
+"""MLA001 firing twin: a donated buffer is read after the call."""
+import jax
+
+
+def build_step():
+    def step(state, batch):
+        return state + batch
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def train(state, batch):
+    step = build_step()
+    loss = step(state, batch)  # `state` donated at position 0 ...
+    norm = state.mean()        # ... and read again: heap-corruption class
+    return loss, norm
+
+
+def direct(state, batch):
+    step = jax.jit(lambda s, b: s + b, donate_argnums=(0,))
+    out = step(state, batch)
+    return out + state.sum()   # read after donation through a direct bind

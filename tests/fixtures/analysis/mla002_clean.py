@@ -1,0 +1,16 @@
+"""MLA002 clean twin: static projections inside jit, host work outside."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x):
+    rows = x.shape[0]      # shape is static at trace time — fine
+    jax.debug.print("rows {r}", r=rows)
+    return jnp.sum(x) / rows
+
+
+def host_side(y):
+    # not a traced body: concretizing here is the NORMAL post-step path
+    arr = jax.device_get(y)
+    return float(arr.sum())

@@ -1,0 +1,18 @@
+"""MLA002 firing twin: host syncs on traced values inside jitted bodies."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    v = x * 2
+    print(v)              # prints the tracer once at trace time
+    host = np.asarray(v)  # device->host pull inside the traced body
+    return float(host.sum())
+
+
+def make_fwd():
+    def fwd(x):
+        return x.sum().item()  # .item() forces a sync
+
+    return jax.jit(fwd)

@@ -1,0 +1,21 @@
+"""MLA003 clean twin: static branches, is-None checks, lax control flow."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnums=(1,))
+def step(x, training):
+    if training:            # static_argnums: concrete at trace time
+        x = x * 2
+    return jnp.where(x > 0, x, -x)
+
+
+@jax.jit
+def masked(x, mask=None):
+    if mask is None:        # is-None dispatch is the sanctioned pattern
+        return x
+    if x.ndim > 1:          # ndim is a static projection
+        x = x.reshape(-1)
+    return x * mask.reshape(-1)

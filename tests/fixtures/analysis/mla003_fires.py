@@ -1,0 +1,16 @@
+"""MLA003 firing twin: Python control flow on traced values."""
+import jax
+
+
+@jax.jit
+def relu_ish(x):
+    if x > 0:          # branch on a tracer: baked in at trace time
+        return x
+    return -x
+
+
+@jax.jit
+def drain(x):
+    while x.sum() > 0:  # tracer-dependent loop bound
+        x = x - 1
+    return x

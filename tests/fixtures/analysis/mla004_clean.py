@@ -1,0 +1,14 @@
+"""MLA004 clean twin: every draw derives from an explicitly seeded
+Generator — the discipline that keeps multi-host plans in lockstep."""
+import random
+
+import numpy as np
+
+ORACLE_SEED = 0x5EED
+
+
+def plan(items, epoch):
+    rng = np.random.default_rng(np.random.SeedSequence([ORACLE_SEED, epoch]))
+    rng.shuffle(items)
+    py_rng = random.Random(ORACLE_SEED + epoch)
+    return py_rng.choice(items), rng.random(len(items))

@@ -1,0 +1,12 @@
+"""MLA004 firing twin (the test maps this file to
+``ml_recipe_tpu/data/packing.py`` in a scratch tree): process-global RNG
+draws on the multi-host lockstep path."""
+import random
+
+import numpy as np
+
+
+def plan(items):
+    np.random.shuffle(items)     # numpy global state: hosts diverge
+    pick = random.choice(items)  # python global state: same failure
+    return pick, np.random.rand(len(items))

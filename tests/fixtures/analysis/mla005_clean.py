@@ -1,0 +1,44 @@
+"""MLA005 clean twin: broad handlers that handle, narrow ones that may
+pass."""
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def risky():
+    raise ValueError("boom")
+
+
+def logs():
+    try:
+        risky()
+    except Exception:
+        logger.exception("risky failed")
+
+
+def falls_back(default):
+    try:
+        return risky()
+    except Exception:
+        return default
+
+
+def sets_state(state):
+    try:
+        risky()
+    except Exception as e:
+        state.last_error = e
+
+
+def reraises():
+    try:
+        risky()
+    except Exception:
+        raise
+
+
+def narrow_pass():
+    try:
+        risky()
+    except ValueError:  # narrow catch: the rule only polices broad ones
+        pass

@@ -1,0 +1,27 @@
+"""MLA005 firing twin: a bare except and a silent broad swallow."""
+
+
+def risky():
+    raise ValueError("boom")
+
+
+def bare():
+    try:
+        risky()
+    except:          # noqa: E722 - the point of the fixture
+        pass
+
+
+def silent_swallow():
+    try:
+        risky()
+    except Exception:
+        pass         # neither re-raises, logs, returns, nor sets state
+
+
+def silent_continue(items):
+    for item in items:
+        try:
+            risky()
+        except BaseException:
+            continue  # still a swallow: loop control is not handling

@@ -1,0 +1,12 @@
+"""MLA006 clean twin: intervals read the monotonic clock."""
+import time
+
+
+def elapsed(work):
+    t0 = time.perf_counter()
+    work()
+    return time.perf_counter() - t0
+
+
+def stamp_ns():
+    return time.monotonic_ns()

@@ -1,0 +1,15 @@
+"""MLA006 firing twin: wall-clock reads used as an interval clock."""
+import time
+from time import time as now
+
+
+def elapsed(work):
+    t0 = time.time()
+    work()
+    return time.time() - t0
+
+
+def elapsed_bare(work):
+    t0 = now()
+    work()
+    return now() - t0

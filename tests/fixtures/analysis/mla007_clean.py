@@ -1,0 +1,20 @@
+"""MLA007 clean twin: `with` blocks, or acquire paired with
+try/finally — the two exception-safe holds."""
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def bump(self):
+        with self._lock:
+            self.value += 1
+
+    def legacy_bump(self):
+        self._lock.acquire()
+        try:
+            self.value += 1
+        finally:
+            self._lock.release()

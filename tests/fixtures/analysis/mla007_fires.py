@@ -1,0 +1,19 @@
+"""MLA007 firing twin: manual lock handling with no exception safety."""
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def bump(self):
+        self._lock.acquire()   # an exception below leaves the lock held
+        self.value += 1
+        self._lock.release()   # success-path-only release
+
+
+def module_level():
+    lock = threading.RLock()
+    lock.acquire()             # no release anywhere in sight
+    return lock
