@@ -998,6 +998,22 @@ def bench_converge(args) -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _goodput_json(summary: dict) -> dict:
+    """Compact goodput summary for the bench JSON line: ratio + the
+    nonzero badput categories, rounded."""
+    ratio = summary.get("goodput_ratio")
+    return {
+        "goodput_ratio": round(ratio, 4) if ratio is not None else None,
+        "total_wall_s": round(summary.get("total_wall_s", 0.0), 4),
+        "productive_s": round(summary.get("productive_s", 0.0), 4),
+        "badput_s": {
+            k: round(v, 4)
+            for k, v in summary.get("badput_s", {}).items()
+            if v > 0.0005
+        },
+    }
+
+
 def _opt_bytes(trainer):
     """Measured per-chip optimizer-state bytes of a live trainer (one
     shard per leaf under zero1), or None before init."""
@@ -1335,12 +1351,25 @@ def main() -> None:
             trainer._split_micro(host_labels), leading_accum=True
         )
 
+        # in-memory goodput accountant (metrics/goodput.py, path=None):
+        # the warmup leg (compile + first dispatches) is compile/warmup
+        # badput, the measured windows are productive — the same partition
+        # --goodput_ledger keeps for real runs, on the bench JSON line
+        from ml_recipe_tpu.metrics.goodput import GoodputLedger
+
+        goodput = GoodputLedger(None)
+        goodput.note_run_start(0)
+
+        t_warm = time.perf_counter()
         params_d, opt_d = trainer.params, trainer.opt_state
         for i in range(args.warmup):
             params_d, opt_d, values = step_fn(params_d, opt_d, inputs, labels, i)
         # sync via a host fetch: block_until_ready does NOT actually block
         # through the tunneled single-chip backend
         float(values["loss"])
+        goodput.note_step(
+            0, wall_s=time.perf_counter() - t_warm, compile=True
+        )
 
         win = max(1, args.window)
         sizes = [win] * (args.steps // win)
@@ -1356,7 +1385,11 @@ def main() -> None:
                 )
                 step_i += 1
             float(values["loss"])  # host fetch = window sync
-            window_step_s.append((time.perf_counter() - t0) / size)
+            per_step = (time.perf_counter() - t0) / size
+            window_step_s.append(per_step)
+            for k in range(size):
+                goodput.note_step(step_i - size + k, wall_s=per_step)
+        goodput.note_run_end(step_i)
 
     # observability twins of the --metrics_port surface: step-time
     # percentiles over the measured windows + the slow-step detector run
@@ -1417,6 +1450,9 @@ def main() -> None:
                 "step_time_ms_p95": round(
                     float(np.percentile(window_step_s, 95)) * 1e3, 1),
                 "slow_step_anomalies": detector.anomalies,
+                # run-level goodput partition of this bench invocation:
+                # warmup/compile is badput, measured windows productive
+                "goodput": _goodput_json(goodput.summary()),
                 "global_batch": args.global_batch,
                 # pre-flight may have raised this above --batch_split
                 "batch_split": trainer.batch_split,

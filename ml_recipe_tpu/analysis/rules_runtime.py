@@ -1,10 +1,13 @@
 """Runtime-discipline rules: exception swallowing, wall-clock intervals,
-manual lock handling.
+manual lock handling, non-atomic telemetry-artifact writes.
 
 MLA005 absorbs scripts/check_bare_except.sh (the shell script is now a
 thin wrapper over this rule) and generalizes it: a broad handler that
 neither re-raises, logs, returns, nor mutates state is a silent
 swallow. MLA006 absorbs the old `time.time()` grep in tests/test_lint.py.
+MLA008 guards the observability artifacts (ledger / flight recorder /
+sidecar / trace files) that other processes read mid-run: write-mode
+``open()`` there is legal only inside the tmp + ``os.replace`` idiom.
 """
 
 from __future__ import annotations
@@ -189,6 +192,93 @@ def _inside_try_with_final_release(call: ast.Call, recv: str) -> bool:
         if isinstance(anc, ast.Try) and _releases_in(anc.finalbody, recv):
             return True
     return False
+
+
+@register(
+    "MLA008", "non-atomic-telemetry-write", "error",
+    summary=(
+        "a write-mode `open()` in the telemetry-artifact modules "
+        "(`metrics/`, `resilience/`) whose enclosing function never calls "
+        "`os.replace`/`os.rename` — a concurrent reader (exporter scrape, "
+        "supervisor peek, flight-record read-back) can observe a torn "
+        "half-written file"
+    ),
+    rationale=(
+        "the goodput ledger, flight-recorder dumps, trace files and the "
+        "supervisor sidecar are read by OTHER processes exactly when the "
+        "writer may be dying (PR 13); one raw `open(...).write` there "
+        "corrupts the artifact at the moment it matters most — write via "
+        "`metrics.artifacts` (atomic tmp+rename / O_APPEND JSONL)"
+    ),
+)
+def check_non_atomic_telemetry_write(ctx: Context) -> Iterable[Finding]:
+    from .engine import get_rule
+
+    rule = get_rule("MLA008")
+    for src in ctx.files:
+        if not _mla008_in_scope(src.path):
+            continue
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and A.dotted(node.func) == "open"):
+                continue
+            mode = _open_mode(node)
+            if mode is None or not any(c in mode for c in "wax+"):
+                continue
+            if _scope_swaps_atomically(node):
+                continue
+            yield rule.finding(
+                src, node,
+                f"write-mode open({mode!r}) outside the atomic tmp + "
+                f"os.replace idiom — a reader can see a torn artifact; "
+                f"use metrics.artifacts.atomic_write_json / append_jsonl "
+                f"(or rename a tmp file into place)",
+            )
+
+
+_MLA008_SCOPE = ("ml_recipe_tpu/metrics/", "ml_recipe_tpu/resilience/")
+
+
+def _mla008_in_scope(path: str) -> bool:
+    return any(path.startswith(prefix) for prefix in _MLA008_SCOPE)
+
+
+def _open_mode(call: ast.Call) -> Optional[str]:
+    """The literal mode string of an ``open()`` call; None when absent
+    (read) or not statically known (give the benefit of the doubt)."""
+    mode_node = None
+    if len(call.args) >= 2:
+        mode_node = call.args[1]
+    else:
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode_node = kw.value
+                break
+    if mode_node is None:
+        return None
+    if isinstance(mode_node, ast.Constant) and isinstance(mode_node.value, str):
+        return mode_node.value
+    return None
+
+
+def _scope_swaps_atomically(call: ast.Call) -> bool:
+    """True when the enclosing function (or module, for top-level code)
+    also calls ``os.replace``/``os.rename`` — the write lands in a tmp
+    file that is atomically swapped into place."""
+    scope = A.enclosing_function(call)
+    tree: ast.AST = scope if scope is not None else _module_of(call)
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and A.dotted(node.func) in ("os.replace", "os.rename")):
+            return True
+    return False
+
+
+def _module_of(node: ast.AST) -> ast.AST:
+    last = node
+    for anc in A.ancestors(node):
+        last = anc
+    return last
 
 
 @register(

@@ -155,10 +155,47 @@ def _run_instrumented(params, model_params, watchdog, local_logger, mesh,
 
     exp_dir = params.dump_dir / params.experiment_name
     telemetry = None
-    if getattr(params, "metrics_port", None) is not None:
+    goodput = None
+    flightrec = None
+    if getattr(params, "goodput_ledger", False):
+        from ..metrics.goodput import GOODPUT_FILENAME, GoodputLedger
+
+        # lives next to supervisor_state.json; construction reads prior
+        # attempts' events, so a resumed run reports whole-run goodput.
+        # Only process 0 writes the shared file: every host feeds the same
+        # global steps, so N file-backed writers would multiply productive
+        # time by N in the run summary — peers keep an in-memory ledger
+        # (their local /metrics gauges stay honest) and process 0's file
+        # is the run-level record
+        goodput = GoodputLedger(
+            os.path.join(str(exp_dir), GOODPUT_FILENAME)
+            if jax.process_index() == 0 else None,
+            process_index=jax.process_index(),
+        )
+    if getattr(params, "flight_recorder", False):
+        from ..metrics.flightrec import FlightRecorder
+
+        flightrec = FlightRecorder.open_in(
+            str(exp_dir), process_index=jax.process_index(),
+            capacity=getattr(params, "flightrec_events", 256),
+        )
+        if watchdog is not None:
+            # a hang abort dumps the last-K-step timeline before the
+            # watchdog's os._exit(87)
+            watchdog.add_on_timeout(
+                lambda label: flightrec.dump("watchdog", label=label)
+            )
+    if (
+        getattr(params, "metrics_port", None) is not None
+        or goodput is not None
+        or flightrec is not None
+    ):
         from ..resilience.supervisor import STATE_FILENAME
         from ..train.telemetry import TrainTelemetry
 
+        # the telemetry plane is also how the ledger/recorder get their
+        # per-step feeds, so either flag builds it; the HTTP exporter
+        # itself still starts only with --metrics_port
         telemetry = TrainTelemetry(
             process_index=jax.process_index(),
             process_count=jax.process_count(),
@@ -169,6 +206,8 @@ def _run_instrumented(params, model_params, watchdog, local_logger, mesh,
             # reading it cross-process is what puts restart counts on the
             # child's /metrics without any coordination channel
             supervisor_state_path=os.path.join(str(exp_dir), STATE_FILENAME),
+            goodput=goodput,
+            flightrec=flightrec,
         )
 
     model, model_state, tokenizer = init_model(
@@ -236,7 +275,15 @@ def _run_instrumented(params, model_params, watchdog, local_logger, mesh,
     if params.last is not None:
         trainer.load_state_dict(params.last)
 
-    if telemetry is not None:
+    if goodput is not None:
+        # the FIRST step id this attempt will execute: the summarizer
+        # reclassifies previously ledgered work on steps >= it as the
+        # recompute badput a resume pays
+        goodput.note_run_start(trainer.global_step)
+    if flightrec is not None:
+        flightrec.record("run_start", step=trainer.global_step)
+
+    if telemetry is not None and getattr(params, "metrics_port", None) is not None:
         from ..metrics.exporter import MetricsExporter
 
         # multi-host: each process exports its own plane one port up from
@@ -245,21 +292,32 @@ def _run_instrumented(params, model_params, watchdog, local_logger, mesh,
         port = base_port + jax.process_index() if base_port else 0
 
         def health():
-            heartbeat = (
-                watchdog.heartbeat_age() if watchdog is not None else None
+            # one liveness+productivity probe: goodput ratio and flight-
+            # recorder last-event age ride the same document the serving
+            # fleet's router and the supervisor poll
+            return telemetry.health_document(
+                global_step=trainer.global_step,
+                process_index=jax.process_index(),
             )
-            return {
-                "status": "ok",
-                "global_step": trainer.global_step,
-                "process_index": jax.process_index(),
-                "watchdog_heartbeat_age_s": heartbeat,
-            }
 
         # the caller's finally closes it, whatever unwinds from here on
         state["exporter"] = MetricsExporter(
             telemetry.registry, port=port, health_fn=health,
         ).start()
         state["exporter"].add_pre_render(telemetry.refresh)
+
+        hosts = getattr(params, "metrics_hosts", None)
+        if hosts and jax.process_index() == 0:
+            from ..metrics.aggregator import PodAggregator
+
+            # process 0 fans in every host's exporter into one merged
+            # pod page (sum/min/max, per-host views, straggler gauges)
+            aggregator = PodAggregator(str(hosts).split(","))
+            state["exporter"].add_route("/metrics/pod", aggregator.render)
+            local_logger.info(
+                f"Pod-scope aggregation over {len(aggregator.targets)} "
+                f"host exporter(s) at /metrics/pod."
+            )
 
     def save_last(*args, **kwargs):
         trainer.save_state_dict(params.dump_dir / params.experiment_name / "last.ch")
@@ -304,7 +362,18 @@ def _run_instrumented(params, model_params, watchdog, local_logger, mesh,
         if on_main_thread:
             signal.signal(signal.SIGTERM, signal.SIG_IGN)
         local_logger.error("Training process was interrupted.")
+        if flightrec is not None:
+            # before the (fallible) interrupt save: the timeline into the
+            # preemption must survive even a failed emergency checkpoint
+            flightrec.dump("sigterm", step=trainer.global_step)
+        if goodput is not None:
+            # same ordering: the open step window's accounting must land
+            # durably even if the emergency save below fails
+            goodput.flush()
         trainer.save_state_dict(params.dump_dir / params.experiment_name / "interrupt.ch")
+        if goodput is not None:
+            goodput.note_run_end(trainer.global_step)
+            local_logger.warning(goodput.summary_message())
         # under a supervisor, a caught preemption is a reason to RESUME:
         # exit with the tempfail code the supervisor classifies as
         # 'preempted' (a bare return here would read as a clean finish)
@@ -314,7 +383,18 @@ def _run_instrumented(params, model_params, watchdog, local_logger, mesh,
             raise SystemExit(PREEMPT_EXIT_CODE)
     except Exception as e:
         local_logger.error(e)
+        if flightrec is not None:
+            flightrec.dump("exception", error=f"{type(e).__name__}: {e}")
+        if goodput is not None:
+            goodput.flush()  # keep the open step window's accounting
         raise e
+    else:
+        if goodput is not None:
+            goodput.note_run_end(trainer.global_step)
+            local_logger.warning(goodput.summary_message())
+        if flightrec is not None:
+            flightrec.record("run_end", step=trainer.global_step)
+            flightrec.dump("clean")
     finally:
         if on_main_thread:
             signal.signal(signal.SIGTERM, prev_handler)

@@ -610,6 +610,40 @@ def get_trainer_parser() -> ConfigArgumentParser:
     parser.add_argument("--anomaly_window", type=int, default=64,
                         help="Slow-step detector: rolling window size "
                              "(steps) for the median+MAD baseline.")
+    parser.add_argument("--goodput_ledger", action="store_true",
+                        help="Keep the run-level goodput ledger "
+                             "(goodput.jsonl next to supervisor_state.json "
+                             "in the experiment dir): an append-only event "
+                             "log partitioning total run wall-clock into "
+                             "productive step time vs named badput "
+                             "(compile/warmup, data wait, checkpoint "
+                             "save/restore, eval, restart downtime, "
+                             "recomputed steps), summarized at run end and "
+                             "exported as train_goodput_ratio + "
+                             "train_badput_seconds_total{category=...}. "
+                             "Survives supervised restarts. Off by "
+                             "default.")
+    parser.add_argument("--flight_recorder", action="store_true",
+                        help="Arm the crash flight recorder: a bounded "
+                             "ring of the last N structured events (step "
+                             "breakdown, anomaly verdicts, checkpoint "
+                             "events, loss-scale adjustments) dumped "
+                             "atomically to a timestamped JSON in the "
+                             "experiment dir on crash, watchdog abort, "
+                             "SIGTERM and periodically — the supervisor's "
+                             "crash-loop diagnosis reads the newest dump "
+                             "back. Off by default.")
+    parser.add_argument("--flightrec_events", type=int, default=256,
+                        help="Flight recorder: ring capacity (events kept "
+                             "in the crash dump).")
+    parser.add_argument("--metrics_hosts", type=cast2(str), default=None,
+                        help="Comma-separated host:port list of every "
+                             "host's /metrics exporter. Process 0 then "
+                             "serves the pod-scope merged page (sum/min/"
+                             "max + per-host views, slowest-host and "
+                             "step-time-skew gauges) at /metrics/pod on "
+                             "its own exporter. Requires --metrics_port. "
+                             "None (default) disables.")
 
     parser.add_argument("--best_metric", choices=["map"], type=str, default="map",
                         help="Best metric name.")

@@ -1,0 +1,98 @@
+"""Atomic on-disk telemetry artifacts: write-then-rename and O_APPEND JSONL.
+
+Every observability artifact in this repo — the supervisor sidecar, trace
+span files, the goodput ledger, flight-recorder dumps — is read by ANOTHER
+process (an exporter scrape, the supervisor's exit classifier, a human mid
+incident) while the writer may be killed at any byte. Two primitives cover
+all of them:
+
+- :func:`atomic_write_json` — the tmp + ``os.replace`` idiom: a reader sees
+  either the old document or the new one, never a torn half-write.
+- :func:`append_jsonl` / :func:`read_jsonl` — append-only structured event
+  logs. Each record is one ``\\n``-terminated JSON line written with a
+  single ``os.write`` on an ``O_APPEND`` descriptor, so concurrent writers
+  (the supervisor and its child share the goodput ledger) interleave at
+  line granularity; the reader skips a torn final line instead of dying.
+
+graftlint rule MLA008 bans raw write-mode ``open()`` in ``metrics/`` and
+``resilience/`` outside this pattern — route new artifact writers through
+here.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from datetime import datetime, timezone
+from typing import Iterator, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def wall_now() -> float:
+    """Wall-clock EVENT stamp (epoch seconds, UTC) — the shared stamping
+    convention of every telemetry artifact. Cross-process artifacts (the
+    ledger the supervisor and child both append, flight dumps read back
+    after the writer died, trace origins aligned across hosts) need one
+    shared timeline, which only the wall clock provides; durations INSIDE
+    events stay ``perf_counter``-based."""
+    return datetime.now(timezone.utc).timestamp()
+
+
+def atomic_write_json(path, doc, *, indent: Optional[int] = None) -> str:
+    """Serialize ``doc`` to ``path`` atomically (tmp + rename); returns the
+    path. A crash mid-write leaves the previous file intact. The tmp name
+    carries pid AND thread id: a periodic flush racing a terminal dump
+    (two threads, one recorder) must not interleave into one tmp file."""
+    path = os.fspath(path)
+    directory = os.path.dirname(path)
+    if directory:
+        os.makedirs(directory, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=indent)
+    os.replace(tmp, path)
+    return path
+
+
+def append_jsonl(path, record: dict) -> None:
+    """Append one JSON record as a single line via one ``os.write`` on an
+    ``O_APPEND`` descriptor — POSIX appends of one small buffer land whole,
+    so two processes appending to the same ledger interleave cleanly."""
+    path = os.fspath(path)
+    directory = os.path.dirname(path)
+    if directory:
+        os.makedirs(directory, exist_ok=True)
+    line = json.dumps(record, separators=(",", ":")) + "\n"
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        os.write(fd, line.encode("utf-8"))
+    finally:
+        os.close(fd)
+
+
+def read_jsonl(path) -> List[dict]:
+    """Every parseable record in ``path`` (empty list when absent). A torn
+    final line — the writer was killed mid-append, which is exactly the
+    scenario these logs exist to survive — is skipped with a debug note,
+    never an error."""
+    out: List[dict] = []
+    try:
+        with open(os.fspath(path)) as fh:
+            lines: Iterator[str] = iter(fh.readlines())
+    except OSError:
+        return out
+    for lineno, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            record = json.loads(line)
+        except ValueError:
+            logger.debug("skipping torn ledger line %s:%d", path, lineno)
+            continue
+        if isinstance(record, dict):
+            out.append(record)
+    return out

@@ -23,7 +23,7 @@ import json
 import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from .registry import Registry
 
@@ -47,6 +47,7 @@ class _MetricsHandler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 - http.server API
         try:
+            extra = self.server.route(self.path)
             if self.path == "/metrics":
                 self._send(
                     200, self.server.render().encode("utf-8"),
@@ -57,6 +58,23 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                     200, json.dumps(self.server.health()).encode("utf-8"),
                     "application/json",
                 )
+            elif extra is not None:
+                render_fn, content_type = extra
+                try:
+                    body = render_fn()
+                except Exception as e:  # noqa: BLE001 - a broken extra route
+                    # (e.g. pod aggregation mid-topology-change) must 500,
+                    # not take the exporter thread down
+                    logger.exception(f"route {self.path} failed")
+                    self._send(
+                        500,
+                        json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}
+                        ).encode(),
+                        "application/json",
+                    )
+                    return
+                self._send(200, body.encode("utf-8"), content_type)
             else:
                 self._send(
                     404,
@@ -72,11 +90,16 @@ class _MetricsHTTPServer(ThreadingHTTPServer):
 
     def __init__(self, addr, registry: Registry,
                  health_fn: Optional[Callable[[], dict]],
-                 pre_render: List[Callable[[], None]]):
+                 pre_render: List[Callable[[], None]],
+                 routes: Dict[str, tuple]):
         super().__init__(addr, _MetricsHandler)
         self._registry = registry
         self._health_fn = health_fn
         self._pre_render = pre_render
+        self._routes = routes
+
+    def route(self, path: str):
+        return self._routes.get(path)
 
     def render(self) -> str:
         for hook in self._pre_render:
@@ -110,8 +133,9 @@ class MetricsExporter:
     ):
         self.registry = registry
         self._pre_render: List[Callable[[], None]] = []
+        self._routes: Dict[str, tuple] = {}
         self._httpd = _MetricsHTTPServer(
-            (host, port), registry, health_fn, self._pre_render
+            (host, port), registry, health_fn, self._pre_render, self._routes
         )
         self._thread: Optional[threading.Thread] = None
 
@@ -126,6 +150,19 @@ class MetricsExporter:
     def add_pre_render(self, hook: Callable[[], None]) -> None:
         """Run ``hook`` before every /metrics render (scrape-time gauges)."""
         self._pre_render.append(hook)
+
+    def add_route(
+        self,
+        path: str,
+        render_fn: Callable[[], str],
+        content_type: str = "text/plain; version=0.0.4; charset=utf-8",
+    ) -> None:
+        """Serve ``render_fn()`` at ``path`` (e.g. the pod-scope merged
+        page at ``/metrics/pod``). ``/metrics`` and ``/healthz`` stay
+        reserved."""
+        if path in ("/metrics", "/healthz"):
+            raise ValueError(f"route {path!r} is reserved")
+        self._routes[path] = (render_fn, content_type)
 
     def render(self) -> str:
         """Render exactly what a scrape would see (bench/tests)."""

@@ -129,6 +129,45 @@ class Info:
         return [(f"{self.name}{{{label_str}}}", 1.0)]
 
 
+class LabeledGauge:
+    """One-label gauge family: ``name{label="key"} value`` per key — the
+    per-category badput series (``train_badput_seconds_total{category=...}``)
+    without a full label-aware metric model. Keys render sorted; values are
+    replaced per key (``set``) or accumulated (``inc``)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help_: str, label: str):
+        self.name = name
+        self.help = help_
+        self.label = label
+        self._values: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, key: str, value: float) -> None:
+        with self._lock:
+            self._values[str(key)] = float(value)
+
+    def inc(self, key: str, amount: float = 1.0) -> None:
+        with self._lock:
+            self._values[str(key)] = self._values.get(str(key), 0.0) + amount
+
+    def value(self, key: str) -> Optional[float]:
+        with self._lock:
+            return self._values.get(str(key))
+
+    def values(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._values)
+
+    def samples(self) -> List[Tuple[str, float]]:
+        with self._lock:
+            return [
+                (f'{self.name}{{{self.label}="{Info._escape(k)}"}}', v)
+                for k, v in sorted(self._values.items())
+            ]
+
+
 # default latency buckets: 1 ms .. 30 s (request latency on a serving box)
 DEFAULT_BUCKETS = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
@@ -246,6 +285,9 @@ class Registry:
 
     def info(self, name: str, help_: str, labels: Dict[str, str]) -> Info:
         return self.register(Info(name, help_, labels))
+
+    def labeled_gauge(self, name: str, help_: str, label: str) -> LabeledGauge:
+        return self.register(LabeledGauge(name, help_, label))
 
     def get(self, name: str):
         with self._lock:

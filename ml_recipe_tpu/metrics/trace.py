@@ -24,12 +24,14 @@ interval clock is the correct source (and the wall clock is not).
 from __future__ import annotations
 
 import contextlib
-import json
+import functools
 import logging
 import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
+
+from .artifacts import atomic_write_json, wall_now
 
 logger = logging.getLogger(__name__)
 
@@ -52,6 +54,10 @@ class TraceWriter:
     def __init__(self, path: str, *, process_name: str = "ml_recipe_tpu"):
         self.path = os.fspath(path)
         self.origin = time.perf_counter()
+        # wall-clock anchor of the perf_counter origin: scripts/
+        # merge_traces.py aligns per-host trace files onto one timeline
+        # with it (an EVENT stamp, so the wall clock is the right source)
+        self.origin_unix = wall_now()
         self._events: List[Dict[str, Any]] = []
         self._dropped = 0
         self._lock = threading.Lock()
@@ -142,16 +148,13 @@ class TraceWriter:
             "otherData": {
                 "producer": "ml_recipe_tpu.metrics.trace",
                 "dropped_events": dropped,
+                "process_name": self._meta,
+                # wall anchor of ts==0 on this writer's clock, for the
+                # cross-host alignment in scripts/merge_traces.py
+                "origin_unix": self.origin_unix,
             },
         }
-        directory = os.path.dirname(self.path)
-        if directory:
-            os.makedirs(directory, exist_ok=True)
-        tmp = f"{self.path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as fh:
-            json.dump(doc, fh)
-        os.replace(tmp, self.path)
-        return self.path
+        return atomic_write_json(self.path, doc)
 
     def close(self) -> str:
         path = self.flush()
@@ -205,6 +208,35 @@ def instant(name: str, *, cat: str = "host",
     tracer = _active
     if tracer is not None:
         tracer.instant(name, cat=cat, args=args)
+
+
+# -- wall-time profiling decorator (the legacy utils.profiler surface) ---------
+
+
+def time_profiler(fun):
+    """Log a function call's wall time AND emit it as a trace span.
+
+    This is the reference-parity ``time_profiler`` decorator
+    (``utils.profiler`` keeps the public name as a thin shim), migrated
+    onto the span plane: when a tracer is installed, ``_train``/``_test``
+    and every other decorated unit appear as ``cat="profile"`` spans on the
+    same Perfetto timeline as the step/checkpoint spans; without one, only
+    the historical log line is emitted.
+    """
+
+    @functools.wraps(fun)
+    def _profiled_func(*args, **kwargs):
+        start = time.perf_counter()
+        try:
+            return fun(*args, **kwargs)
+        finally:
+            end = time.perf_counter()
+            complete(fun.__name__, start, end, cat="profile")
+            logger.info(
+                f"Execution of {fun.__name__} took {end - start:.3f} sec."
+            )
+
+    return _profiled_func
 
 
 # -- xplane window (the trainer's staged on-chip capture) ----------------------

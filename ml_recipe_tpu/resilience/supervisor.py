@@ -169,6 +169,8 @@ class Supervisor:
         attempt_timeout: Optional[float] = None,
         sleep: Callable[[float], None] = time.sleep,
         state_path=None,
+        ledger_path=None,
+        flight_dir=None,
     ):
         self.launch = launch
         self.progress = progress
@@ -176,9 +178,51 @@ class Supervisor:
         self.attempt_timeout = attempt_timeout
         self.sleep = sleep
         self.state_path = os.fspath(state_path) if state_path else None
+        # goodput ledger (metrics/goodput.py): attempt boundaries appended
+        # here partition restart downtime out of the run's wall-clock
+        self.ledger_path = os.fspath(ledger_path) if ledger_path else None
+        # flight-recorder dumps (metrics/flightrec.py) live here; the exit
+        # classifier reads the newest one back into its diagnoses
+        self.flight_dir = os.fspath(flight_dir) if flight_dir else None
         self._rng = random.Random(self.policy.seed)
         self._child = None
         self._terminate_signum: Optional[int] = None
+
+    def _ledger_event(self, ev: str, **fields) -> None:
+        """Append an attempt-boundary event to the goodput ledger; a
+        failure degrades accounting, never supervision (same contract as
+        the sidecar)."""
+        if self.ledger_path is None:
+            return
+        from ..metrics.goodput import append_event
+
+        try:
+            append_event(self.ledger_path, ev, pid=os.getpid(), **fields)
+        except OSError as e:
+            logger.warning(
+                f"SUPERVISOR: could not append {ev} to the goodput ledger "
+                f"{self.ledger_path}: {e}"
+            )
+
+    def _flight_timeline(self) -> str:
+        """The newest flight-record dump's last-K-step timeline, rendered
+        for a diagnosis ('' when no recorder ran or nothing is readable)."""
+        if self.flight_dir is None:
+            return ""
+        from ..metrics.flightrec import newest_flight_record, timeline_lines
+
+        found = newest_flight_record(self.flight_dir)
+        if found is None:
+            return ""
+        path, doc = found
+        lines = timeline_lines(doc, last=8)
+        if not lines:
+            return ""
+        return (
+            f"\nFlight recorder ({os.path.basename(path)}, dumped on "
+            f"{doc.get('reason', '?')}): last {len(lines)} event(s):\n"
+            + "\n".join(lines)
+        )
 
     def _persist_state(
         self,
@@ -322,6 +366,9 @@ class Supervisor:
                 f"{restarts_used}/{p.max_restarts} used; resume step: "
                 f"{step_before if step_before is not None else 'fresh'})."
             )
+            self._ledger_event(
+                "attempt_start", attempt=attempt_i, resume_step=step_before
+            )
             self._child = self.launch(attempt_i)
             try:
                 rc = self._wait(self._child)
@@ -329,6 +376,10 @@ class Supervisor:
                 self._child = None
             outcome = classify_exit(rc)
             step_after = self.progress()
+            self._ledger_event(
+                "attempt_end", attempt=attempt_i, returncode=rc,
+                outcome=outcome, step=step_after,
+            )
             attempt = Attempt(attempt_i, rc, outcome, step_before, step_after)
             attempts.append(attempt)
             attempt_i += 1
@@ -368,6 +419,7 @@ class Supervisor:
                     f"{step_after if step_after is not None else 'none'}); "
                     f"aborting — restarting further would burn the retry "
                     f"budget without converging."
+                    + self._flight_timeline()
                 )
                 logger.error(diagnosis)
                 sys.stderr.write(diagnosis + "\n")
@@ -387,6 +439,7 @@ class Supervisor:
             f"SUPERVISOR: retry budget exhausted after "
             f"{len(attempts)} attempts (outcomes: "
             f"{', '.join(a.outcome for a in attempts)})."
+            + self._flight_timeline()
         )
         logger.error(diagnosis)
         sys.stderr.write(diagnosis + "\n")
@@ -483,8 +536,20 @@ def supervise_cli(params, argv: Sequence[str]) -> int:
         crash_loop_window=getattr(params, "crash_loop_window", 3),
         seed=getattr(params, "seed", None) or 0,
     )
+    from ..metrics.goodput import GOODPUT_FILENAME
+
     result = Supervisor(
         launch, progress=progress, policy=policy,
         state_path=os.path.join(exp_dir, STATE_FILENAME),
+        # attempt boundaries land in the same ledger the child feeds, so
+        # restart downtime is partitioned out of the run wall-clock
+        ledger_path=(
+            os.path.join(exp_dir, GOODPUT_FILENAME)
+            if getattr(params, "goodput_ledger", False) else None
+        ),
+        # crash-loop diagnoses read the newest flight-record dump back
+        flight_dir=(
+            exp_dir if getattr(params, "flight_recorder", False) else None
+        ),
     ).run()
     return result.exit_code

@@ -146,6 +146,24 @@ class Watchdog:
         finally:
             self.disarm()
 
+    def add_on_timeout(self, hook: Callable[[str], None]) -> None:
+        """Chain ``hook`` onto the timeout path (runs after any existing
+        hook, before the process exit) — how the flight recorder gets its
+        dump out on a hang abort. Hook failures are already contained by
+        the firing path: the exit must happen regardless."""
+        prev = self.on_timeout
+
+        def chained(label: str) -> None:
+            if prev is not None:
+                try:
+                    prev(label)
+                except Exception:  # noqa: BLE001 - dying anyway; the next
+                    # hook (and the exit) must still run
+                    logger.exception("watchdog on_timeout hook failed")
+            hook(label)
+
+        self.on_timeout = chained
+
     def note_progress(self, step: int) -> None:
         with self._lock:
             self._last_step = int(step)

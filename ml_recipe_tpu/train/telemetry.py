@@ -60,12 +60,21 @@ class TrainTelemetry:
         anomaly_min_steps: int = 8,
         watchdog=None,
         supervisor_state_path=None,
+        goodput=None,
+        flightrec=None,
     ):
         self.registry = registry if registry is not None else Registry()
         self.watchdog = watchdog
         self.supervisor_state_path = (
             str(supervisor_state_path) if supervisor_state_path else None
         )
+        # run-level accounting plane (PR 13), both optional and host-only:
+        # goodput is a metrics.goodput.GoodputLedger (productive-vs-badput
+        # wall-clock partition, exported as train_goodput_ratio), flightrec
+        # a metrics.flightrec.FlightRecorder (last-N-events crash timeline)
+        self.goodput = goodput
+        self.flightrec = flightrec
+        self._observed_steps = 0
         self.detector = SlowStepDetector(
             factor=anomaly_factor,
             window=anomaly_window,
@@ -144,6 +153,17 @@ class TrainTelemetry:
         self.m_sup_crash = m.gauge(
             "train_supervisor_exits_crash",
             "Child exits the supervisor classified as crashes.")
+        self.m_goodput = m.gauge(
+            "train_goodput_ratio",
+            "Productive step time / total run wall-clock, from the goodput "
+            "ledger (-1: no ledger attached).")
+        self.m_badput = m.labeled_gauge(
+            "train_badput_seconds_total",
+            "Non-productive run wall-clock by category, from the goodput "
+            "ledger (compile_warmup / data_wait / checkpoint_save / "
+            "checkpoint_restore / eval / restart_downtime / recompute / "
+            "other).",
+            "category")
         self.m_process = m.info(
             "train_process_info",
             "Identity of this training process on the mesh.",
@@ -155,6 +175,7 @@ class TrainTelemetry:
         self.m_heartbeat_age.set(-1.0)
         self.m_sup_restarts.set(-1.0)
         self.m_sup_attempts.set(-1.0)
+        self.m_goodput.set(-1.0)
 
     # -- per-step feed (train loop) --------------------------------------------
 
@@ -200,10 +221,44 @@ class TrainTelemetry:
             self.m_padding_waste.set(
                 100.0 * (1.0 - real_tokens / total_tokens))
 
+        # goodput ledger: the first observed step carries compilation —
+        # its non-wait share is compile/warmup badput, not productive time
+        first = self._observed_steps == 0
+        self._observed_steps += 1
+        if self.goodput is not None:
+            self.goodput.note_step(
+                step, wall_s=total, data_wait_s=data_wait_s, compile=first
+            )
+
         report = self.detector.update(step, total, breakdown)
         if report is not None:
             self.m_slow_steps.inc()
             logger.warning(report.message())
+
+        if self.flightrec is not None:
+            heartbeat = (
+                self.watchdog.heartbeat_age()
+                if self.watchdog is not None else None
+            )
+            self.flightrec.record(
+                "step", step=int(step), total_s=round(total, 6),
+                data_wait_s=round(data_wait_s, 6),
+                host_s=round(host_s, 6), device_s=round(device_s, 6),
+                examples=int(examples),
+                heartbeat_age_s=(
+                    round(heartbeat, 3) if heartbeat is not None else None
+                ),
+            )
+            if report is not None:
+                # the anomaly verdict rides the ring too: attribution must
+                # survive the crash that often follows a stall
+                self.flightrec.record(
+                    "slow_step", step=report.step,
+                    total_s=round(report.total_s, 6),
+                    threshold_s=round(report.threshold_s, 6),
+                    attribution=report.attribution,
+                    component_s=round(report.component_s, 6),
+                )
         return report
 
     def observe_scalars(self, host_values: Dict[str, float]) -> None:
@@ -226,23 +281,54 @@ class TrainTelemetry:
                 and value != self._last_loss_scale
             ):
                 self.m_loss_scale_adjustments.inc()
+                if self.flightrec is not None:
+                    self.flightrec.record(
+                        "loss_scale", scale=value,
+                        previous=self._last_loss_scale,
+                    )
             self._last_loss_scale = value
 
     # -- checkpoint + scrape-time feeds ----------------------------------------
 
     def observe_checkpoint_save(self, seconds: float) -> None:
         self.m_ckpt_save.observe(seconds)
+        if self.goodput is not None:
+            self.goodput.note_checkpoint("save", seconds)
+        if self.flightrec is not None:
+            self.flightrec.record(
+                "checkpoint_save", seconds=round(seconds, 6))
 
     def observe_checkpoint_restore(self, seconds: float) -> None:
         self.m_ckpt_restore.observe(seconds)
+        if self.goodput is not None:
+            self.goodput.note_checkpoint("restore", seconds)
+        if self.flightrec is not None:
+            self.flightrec.record(
+                "checkpoint_restore", seconds=round(seconds, 6))
+
+    def observe_eval(self, seconds: float) -> None:
+        """One eval epoch's wall time — badput under the goodput
+        discipline (chips busy, no training progress)."""
+        if self.goodput is not None:
+            self.goodput.note_eval(seconds)
+        if self.flightrec is not None:
+            self.flightrec.record("eval", seconds=round(seconds, 6))
 
     def refresh(self) -> None:
-        """Scrape-time gauges: watchdog heartbeat age + supervisor sidecar
-        (registered as the exporter's pre-render hook)."""
+        """Scrape-time gauges: watchdog heartbeat age, goodput accounting
+        + supervisor sidecar (registered as the exporter's pre-render
+        hook)."""
         age = None
         if self.watchdog is not None:
             age = self.watchdog.heartbeat_age()
         self.m_heartbeat_age.set(age if age is not None else -1.0)
+
+        if self.goodput is not None:
+            summary = self.goodput.summary()
+            ratio = summary["goodput_ratio"]
+            self.m_goodput.set(ratio if ratio is not None else -1.0)
+            for category, seconds in summary["badput_s"].items():
+                self.m_badput.set(category, seconds)
 
         if self.supervisor_state_path is None:
             return
@@ -257,6 +343,29 @@ class TrainTelemetry:
         self.m_sup_preempted.set(float(outcomes.count("preempted")))
         self.m_sup_hang.set(float(outcomes.count("hang")))
         self.m_sup_crash.set(float(outcomes.count("crash")))
+
+    def health_document(self, *, global_step, process_index: int = 0) -> dict:
+        """The /healthz JSON body: liveness AND productivity in one probe
+        (the serving-fleet router and the supervisor read the same
+        document). Goodput ratio and flight-recorder last-event age are
+        None when the respective plane is not attached."""
+        heartbeat = (
+            self.watchdog.heartbeat_age() if self.watchdog is not None
+            else None
+        )
+        doc = {
+            "status": "ok",
+            "global_step": global_step,
+            "process_index": process_index,
+            "watchdog_heartbeat_age_s": heartbeat,
+            "goodput_ratio": None,
+            "last_event_age_s": None,
+        }
+        if self.goodput is not None:
+            doc["goodput_ratio"] = self.goodput.summary()["goodput_ratio"]
+        if self.flightrec is not None:
+            doc["last_event_age_s"] = self.flightrec.last_event_age()
+        return doc
 
     # -- bench surface ----------------------------------------------------------
 

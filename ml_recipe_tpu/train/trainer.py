@@ -1654,8 +1654,15 @@ class Trainer:
         if callbacks is not None:
             assert all(isinstance(c, TestCallback) for c in callbacks)
 
-        with self.mesh:
-            return self._test(epoch_i, callbacks=callbacks)
+        # eval wall time is badput under the goodput discipline (chips
+        # busy, no training progress): hand it to the ledger via telemetry
+        t0 = time.perf_counter()
+        try:
+            with self.mesh:
+                return self._test(epoch_i, callbacks=callbacks)
+        finally:
+            if self.telemetry is not None:
+                self.telemetry.observe_eval(time.perf_counter() - t0)
 
     @time_profiler
     def _test(self, epoch_i, *, callbacks=None):

@@ -10,27 +10,17 @@ producing xplane dumps readable by TensorBoard/XProf.
 from __future__ import annotations
 
 import contextlib
-import functools
 import logging
 import time
 from typing import Optional
 
+# The wall-time decorator now lives on the trace plane
+# (metrics/trace.py): decorated units (`_train`/`_test`) emit the same log
+# line AND a `cat="profile"` span when a tracer is installed, so their
+# timing rides the unified observability timeline. Public name preserved.
+from ..metrics.trace import time_profiler  # noqa: F401
+
 logger = logging.getLogger(__name__)
-
-
-def time_profiler(fun):
-    """Log wall-time of a function call (reference trainer.py:35-45 parity)."""
-
-    @functools.wraps(fun)
-    def _profiled_func(*args, **kwargs):
-        start = time.perf_counter()
-        try:
-            return fun(*args, **kwargs)
-        finally:
-            elapsed_time = time.perf_counter() - start
-            logger.info(f"Execution of {fun.__name__} took {elapsed_time:.3f} sec.")
-
-    return _profiled_func
 
 
 class StepTimer:

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Merge per-host Perfetto trace files onto one labeled timeline.
+
+Each training process writes its own Chrome trace-event JSON
+(``--trace_spans`` -> ``train_trace_p{i}.json``) with timestamps relative
+to its OWN ``perf_counter`` origin. This tool merges N such files into one
+Perfetto-loadable document:
+
+- every input gets a distinct ``pid`` plus a ``process_name`` metadata
+  event (its label — default: the file name), so Perfetto shows one track
+  group per host;
+- when every input carries the writer's wall-clock anchor
+  (``otherData.origin_unix``, written by ``metrics.trace.TraceWriter``),
+  timestamps are shifted onto the shared wall timeline so cross-host skew
+  is visible; without anchors the files are merged origin-aligned with a
+  loud note.
+
+Usage::
+
+    python scripts/merge_traces.py results/tr/train_trace_p*.json -o pod_trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ml_recipe_tpu.metrics.artifacts import atomic_write_json  # noqa: E402
+
+
+def load_trace(path: str) -> dict:
+    with open(path) as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise ValueError(f"{path}: not a Chrome trace-event JSON document")
+    return doc
+
+
+def merge_traces(docs, labels):
+    """Merge parsed trace documents; returns the merged document. ``docs``
+    and ``labels`` are parallel lists."""
+    anchors = [
+        doc.get("otherData", {}).get("origin_unix") for doc in docs
+    ]
+    aligned = all(isinstance(a, (int, float)) for a in anchors) and anchors
+    base = min(anchors) if aligned else 0.0
+
+    events = []
+    for pid, (doc, label) in enumerate(zip(docs, labels)):
+        shift_us = (anchors[pid] - base) * 1e6 if aligned else 0.0
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": label},
+        })
+        for event in doc["traceEvents"]:
+            merged = dict(event)
+            merged["pid"] = pid
+            if isinstance(merged.get("ts"), (int, float)):
+                merged["ts"] = merged["ts"] + shift_us
+            events.append(merged)
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "scripts.merge_traces",
+            "aligned": bool(aligned),
+            "sources": list(labels),
+        },
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Merge per-host Perfetto trace files onto one timeline."
+    )
+    parser.add_argument("inputs", nargs="+", help="Per-host trace JSON files.")
+    parser.add_argument("-o", "--output", required=True,
+                        help="Merged trace output path.")
+    parser.add_argument("--labels", default=None,
+                        help="Comma-separated track labels (default: file "
+                             "names).")
+    args = parser.parse_args(argv)
+
+    labels = (
+        [s.strip() for s in args.labels.split(",")]
+        if args.labels else [os.path.basename(p) for p in args.inputs]
+    )
+    if len(labels) != len(args.inputs):
+        parser.error(
+            f"{len(labels)} labels for {len(args.inputs)} inputs"
+        )
+    docs = [load_trace(p) for p in args.inputs]
+    merged = merge_traces(docs, labels)
+    if not merged["otherData"]["aligned"]:
+        sys.stderr.write(
+            "note: inputs lack origin_unix anchors; merged origin-aligned "
+            "(cross-host skew not meaningful).\n"
+        )
+
+    # atomic write (shared helper): a merged artifact is often produced
+    # while the run is still being poked at — never leave a half-JSON
+    atomic_write_json(args.output, merged)
+    n = len(merged["traceEvents"])
+    sys.stderr.write(
+        f"merged {len(args.inputs)} trace(s), {n} events -> {args.output}\n"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

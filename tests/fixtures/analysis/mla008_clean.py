@@ -1,0 +1,25 @@
+"""MLA008 clean twin: the tmp + os.replace idiom (what
+metrics.artifacts.atomic_write_json does), plus read-mode opens — none of
+these may fire."""
+
+import json
+import os
+
+
+def dump_state(path, state):
+    # clean: the write targets a tmp file atomically renamed into place
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(state, fh)
+    os.replace(tmp, path)
+
+
+def read_state(path):
+    # clean: read-mode (default) opens are never artifacts being torn
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def read_binary(path):
+    with open(path, "rb") as fh:
+        return fh.read()

@@ -1,0 +1,19 @@
+"""MLA008 firing fixture (mapped under ml_recipe_tpu/metrics/ by the
+test): telemetry artifacts written with a raw write-mode open() — a
+concurrent reader can observe the torn half-document."""
+
+import json
+
+
+def dump_state(path, state):
+    # FIRES: json lands directly in the live file; a reader polling it
+    # mid-write (or after a crash mid-write) sees half a document
+    with open(path, "w") as fh:
+        json.dump(state, fh)
+
+
+def append_record(path, record):
+    # FIRES: buffered text-mode append without the O_APPEND single-write
+    # discipline
+    with open(path, "a") as fh:
+        fh.write(json.dumps(record) + "\n")

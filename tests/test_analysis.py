@@ -37,6 +37,7 @@ ALL_RULE_IDS = [r.id for r in iter_rules()]
 # scratch tree at the path that puts them in scope
 _FIXTURE_DEST = {
     "MLA004": "ml_recipe_tpu/data/packing.py",  # lockstep-path scoped
+    "MLA008": "ml_recipe_tpu/metrics/state_writer.py",  # artifact-path scoped
 }
 
 
