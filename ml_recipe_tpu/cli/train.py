@@ -253,6 +253,9 @@ def _run_instrumented(params, model_params, watchdog, local_logger, mesh,
         seed=params.seed if params.seed is not None else 0,
         optimizer_sharding=getattr(params, "optimizer_sharding", None),
         shard_optimizer=getattr(params, "shard_optimizer", False),
+        zero1_overlap=getattr(params, "zero1_overlap", "off"),
+        zero1_bucket_mb=getattr(params, "zero1_bucket_mb", 4.0),
+        async_checkpoint=getattr(params, "async_checkpoint", False),
         sharded_checkpoint=getattr(params, "sharded_checkpoint", False),
         trace_dir=(
             params.dump_dir / f"board/{params.experiment_name}/trace"
@@ -370,7 +373,15 @@ def _run_instrumented(params, model_params, watchdog, local_logger, mesh,
             # same ordering: the open step window's accounting must land
             # durably even if the emergency save below fails
             goodput.flush()
+        # drain any STALE background-persist failure non-strictly first: a
+        # failed earlier save (already logged) must not abort the very
+        # emergency checkpoint this path exists to produce
+        trainer.finish_pending_checkpoint(raise_errors=False)
         trainer.save_state_dict(params.dump_dir / params.experiment_name / "interrupt.ch")
+        # async checkpointing: the interrupt save must be DURABLE before
+        # this process exits and the supervisor resumes from it — a resume
+        # that races the background persist would restart from stale state
+        trainer.finish_pending_checkpoint()
         if goodput is not None:
             goodput.note_run_end(trainer.global_step)
             local_logger.warning(goodput.summary_message())
@@ -387,8 +398,15 @@ def _run_instrumented(params, model_params, watchdog, local_logger, mesh,
             flightrec.dump("exception", error=f"{type(e).__name__}: {e}")
         if goodput is not None:
             goodput.flush()  # keep the open step window's accounting
+        # best-effort completion barrier: let an in-flight persist land (a
+        # valid checkpoint to resume from beats a torn one) but never mask
+        # the propagating error with a persist failure
+        trainer.finish_pending_checkpoint(raise_errors=False)
         raise e
     else:
+        # at-exit completion barrier: a clean run must not report success
+        # while its final checkpoint is still (or failed) persisting
+        trainer.finish_pending_checkpoint()
         if goodput is not None:
             goodput.note_run_end(trainer.global_step)
             local_logger.warning(goodput.summary_message())

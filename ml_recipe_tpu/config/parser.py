@@ -414,6 +414,35 @@ def get_trainer_parser() -> ConfigArgumentParser:
                              "XLA all-gathers the sharded updates). The "
                              "reference replicates optimizer state per "
                              "process.")
+    parser.add_argument("--zero1_overlap", type=cast2(str), default="off",
+                        choices=["off", "bucketed"],
+                        help="ZeRO-1 collective overlap: 'bucketed' splits "
+                             "the flat gradient accumulation into "
+                             "size-targeted contiguous buckets so each "
+                             "bucket's reduce-scatter / all-gather is "
+                             "independently schedulable and hides under "
+                             "the remaining backward/update compute, "
+                             "instead of one fused tail exchange. Same "
+                             "arithmetic (trajectories agree to GSPMD "
+                             "reduction-order tolerance); 'off' (default) "
+                             "keeps the monolithic exchange verbatim. "
+                             "Inert without an active zero1 layout.")
+    parser.add_argument("--zero1_bucket_mb", type=float, default=4.0,
+                        help="Bucketed ZeRO-1 overlap: target f32 payload "
+                             "per gradient bucket in MB (a single larger "
+                             "leaf gets its own bucket; small leaves "
+                             "coalesce).")
+    parser.add_argument("--async_checkpoint", action="store_true",
+                        help="Async overlapped checkpointing: saves block "
+                             "only for the device-to-host snapshot; the "
+                             "serialize+write persist runs on a background "
+                             "thread with the same per-leaf crc32 and "
+                             "atomic-rename discipline, a completion "
+                             "barrier before the next save / restore / "
+                             "exit / SIGTERM resume, and the previous "
+                             "valid checkpoint staying newest if a crash "
+                             "lands mid-persist. Saved bytes are identical "
+                             "to a sync save of the same step.")
     parser.add_argument("--sharded_checkpoint", action="store_true",
                         help="Checkpoint saves write a per-process sharded "
                              "directory (each host saves only the array "

@@ -83,6 +83,13 @@ def summarize_events(events: List[dict], *, now: Optional[float] = None) -> dict
         "productive_s": 0.0,
         "goodput_ratio": None,
         "badput_s": badput,
+        # async-checkpoint persist time: runs CONCURRENTLY with training
+        # (the whole point of --async_checkpoint), so it is reported as
+        # its own field and deliberately EXCLUDED from the badput
+        # partition — counting it there would double-book wall-clock the
+        # productive steps already own. checkpoint_save badput is the
+        # BLOCKING (critical-path) share only.
+        "checkpoint_overlapped_s": 0.0,
         "steps": 0,
         "recomputed_steps": 0,
         "attempts": 0,
@@ -138,6 +145,11 @@ def summarize_events(events: List[dict], *, now: Optional[float] = None) -> dict
             badput["data_wait"] += float(e.get("data_wait_s", 0.0))
             badput["compile_warmup"] += float(e.get("compile_s", 0.0))
         elif ev == "checkpoint":
+            if e.get("overlapped"):
+                summary["checkpoint_overlapped_s"] += float(
+                    e.get("seconds", 0.0)
+                )
+                continue
             kind = "restore" if e.get("kind") == "restore" else "save"
             badput[f"checkpoint_{kind}"] += float(e.get("seconds", 0.0))
         elif ev == "eval":
@@ -239,12 +251,21 @@ class GoodputLedger:
             if w["steps"] >= self.flush_every:
                 self._flush_window_locked()
 
-    def note_checkpoint(self, kind: str, seconds: float) -> None:
+    def note_checkpoint(self, kind: str, seconds: float, *,
+                        overlapped: bool = False) -> None:
+        """``overlapped=True`` books the time as an async save's
+        background persist: reported in the summary's
+        ``checkpoint_overlapped_s``, NOT as badput (it ran under
+        productive step time — that concurrency is the async-checkpoint
+        win the split exists to make visible)."""
         with self._lock:
-            self._emit({
+            record = {
                 "ev": "checkpoint", "kind": str(kind),
                 "seconds": float(seconds),
-            })
+            }
+            if overlapped:
+                record["overlapped"] = True
+            self._emit(record)
 
     def note_eval(self, seconds: float) -> None:
         with self._lock:
@@ -286,11 +307,16 @@ class GoodputLedger:
         parts = ", ".join(
             f"{k}={v:.1f}s" for k, v in s["badput_s"].items() if v > 0.005
         )
+        overlapped = (
+            f" ({s['checkpoint_overlapped_s']:.1f}s checkpoint persist "
+            f"overlapped under training)"
+            if s.get("checkpoint_overlapped_s", 0.0) > 0.005 else ""
+        )
         return (
             f"GOODPUT: ratio "
             f"{ratio if ratio is None else format(ratio, '.3f')} — "
             f"{s['productive_s']:.1f}s productive of {s['total_wall_s']:.1f}s "
             f"wall over {s['attempts'] or 1} attempt(s), "
             f"{s['recomputed_steps']} recomputed step(s); badput: "
-            f"{parts or 'none'}."
+            f"{parts or 'none'}.{overlapped}"
         )

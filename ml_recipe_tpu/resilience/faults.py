@@ -63,6 +63,10 @@ KNOWN_SITES = (
     "ckpt.pre_shard_write",  # sharded save: before this host's shard file
     "ckpt.pre_manifest",     # sharded save: shards landed, manifest not yet
     "ckpt.mid_swap",         # sharded save: between the swap's two renames
+    "checkpoint.persist",    # persist leg (serialize+write) of any save —
+                             # on the BACKGROUND thread under
+                             # --async_checkpoint, so a kill here is the
+                             # canonical crash-mid-persist drill
     "loader.read",           # every dataset item read (both loaders)
     "loader.prefetch",       # device-prefetch thread, per staged batch
     "dist.rendezvous",       # before jax.distributed.initialize

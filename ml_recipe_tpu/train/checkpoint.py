@@ -48,8 +48,20 @@ class CheckpointLayoutError(RuntimeError):
     opaque shape/structure error halfway through the restore."""
 
 
-def save_state_dict(
-    path,
+def _owned_copy(tree):
+    """Deep host copies of every leaf: the async-checkpoint snapshot must
+    OWN its buffers — on the CPU runtime ``device_get`` can return views
+    into the jax array's buffer, and the very next train step DONATES that
+    buffer (the PR-8 heap-corruption class), so a background persist
+    reading a view would serialize freed memory."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda a: np.array(a, copy=True), tree
+    )
+
+
+def snapshot_state(
     *,
     params,
     opt_state: Any = None,
@@ -57,8 +69,16 @@ def save_state_dict(
     global_step: int = 0,
     extra: Optional[dict] = None,
     is_primary: bool = True,
-) -> None:
-    """Write one msgpack checkpoint file (reference trainer.py:355-379).
+    copy: bool = False,
+) -> Optional[dict]:
+    """Device -> host snapshot for a single-file save: gathers every leaf
+    to full host values and returns the serializable ``state`` dict, or
+    None when this process has nothing to persist. This is the only leg of
+    a save that must block training; :func:`persist_state` (serialize +
+    atomic write) can run on a background thread against the returned
+    snapshot. ``copy=True`` deep-copies every gathered leaf so the
+    snapshot owns its buffers (required whenever persist is deferred —
+    the next train step donates the live arrays).
 
     ``loss_scale`` (the apex-parity scaling state) is stored under its OWN
     key so checkpoints stay structurally loadable when --apex_loss_scale
@@ -71,12 +91,20 @@ def save_state_dict(
     if not is_primary and not needs_collective_gather(
         (params, opt_state, loss_scale)
     ):
-        return
+        return None
+
+    def gather(tree):
+        host = _to_host(tree)
+        # ownership only matters for a deferred persist, and only the
+        # primary persists: non-primary hosts participate in the gather
+        # collective but discard the result, so deep-copying it would
+        # transiently double their host RAM for bytes never written
+        return _owned_copy(host) if copy and is_primary else host
 
     state = {
-        "model": serialization.to_state_dict(_to_host(params)),
+        "model": serialization.to_state_dict(gather(params)),
         "optimizer": (
-            serialization.to_state_dict(_to_host(opt_state))
+            serialization.to_state_dict(gather(opt_state))
             if opt_state is not None
             else None
         ),
@@ -86,13 +114,21 @@ def save_state_dict(
         "global_step": global_step,
     }
     if loss_scale is not None:
-        state["loss_scale"] = serialization.to_state_dict(_to_host(loss_scale))
+        state["loss_scale"] = serialization.to_state_dict(gather(loss_scale))
     if extra:
         state.update(extra)
 
     if not is_primary:
-        return
+        return None
+    return state
 
+
+def persist_state(path, state: dict) -> None:
+    """Serialize + atomically write a :func:`snapshot_state` snapshot —
+    the CPU/IO tail of a save, safe to run on a background thread (no
+    device access, no collectives; the atomic tmp+rename means a crash
+    anywhere in here leaves the previous checkpoint at ``path`` intact)."""
+    _fault("checkpoint.persist")
     path = os.fspath(path)
     if os.path.isdir(path):
         # a sharded-directory checkpoint previously lived at this name (the
@@ -112,6 +148,27 @@ def save_state_dict(
     _fault("ckpt.pre_write")
     _atomic_write(path, serialization.msgpack_serialize(state))
     logger.info(f"State dict was saved to {path}.")
+
+
+def save_state_dict(
+    path,
+    *,
+    params,
+    opt_state: Any = None,
+    loss_scale: Any = None,
+    global_step: int = 0,
+    extra: Optional[dict] = None,
+    is_primary: bool = True,
+) -> None:
+    """Write one msgpack checkpoint file (reference trainer.py:355-379):
+    snapshot then persist, synchronously on the calling thread."""
+    state = snapshot_state(
+        params=params, opt_state=opt_state, loss_scale=loss_scale,
+        global_step=global_step, extra=extra, is_primary=is_primary,
+    )
+    if state is None:
+        return
+    persist_state(path, state)
 
 
 _MANIFEST = "manifest.msgpack"
@@ -356,74 +413,29 @@ def _verify_group_layout(manifest, gname: str, target, path) -> None:
         )
 
 
-def save_state_dict_sharded(
-    path,
+def snapshot_state_sharded(
     *,
     params,
     opt_state: Any = None,
     loss_scale: Any = None,
     global_step: int = 0,
     extra: Optional[dict] = None,
-) -> None:
-    """Per-host sharded checkpoint (SURVEY §7 hard part (c)).
-
-    ``path`` becomes a DIRECTORY: every process writes exactly the array
-    shards it owns (``shard.replica_id == 0`` — each piece of data has one
-    canonical owner across the whole mesh, so replicated leaves are written
-    once and ZeRO/TP-sharded leaves are written piecewise by their holders);
-    the primary also writes a manifest with the tree structure and leaf
-    shapes/dtypes. Unlike :func:`save_state_dict`, NOTHING is gathered: peak
-    host memory is one local shard, not the full state — this is the path
-    that scales to genuinely sharded pod states.
-
-    Layout::
-
-        path/
-          manifest.msgpack          # format tag, step, leaf shapes/dtypes
-          shard-00000.msgpack       # this process's owned shards
-          shard-00001.msgpack       # (one file per process)
-
-    Atomicity: shards are written into a fresh sibling directory
-    (``path + '.saving'``); after a cross-process barrier confirms every
-    shard file landed, the primary writes the manifest LAST (manifest
-    presence therefore implies a complete checkpoint) and swaps the new
-    directory in. An interruption at any point leaves the previous good
-    checkpoint at ``path`` untouched.
-    """
+    copy: bool = False,
+) -> dict:
+    """Device -> host snapshot for a sharded save: copies exactly the
+    array shards this process owns (``shard.replica_id == 0``) to host and
+    builds the manifest — the blocking leg of
+    :func:`save_state_dict_sharded`. NOTHING is gathered: peak host memory
+    is one local shard set, not the full state. ``copy=True`` deep-copies
+    every piece so the snapshot owns its buffers (required whenever
+    :func:`persist_state_sharded` is deferred to a background thread — the
+    next train step donates the live arrays, and a CPU-runtime shard view
+    into a donated buffer would serialize freed memory)."""
     import jax
 
-    path = os.fspath(path)
-    if os.path.isdir(path) and os.listdir(path) and not os.path.exists(
-        os.path.join(path, _MANIFEST)
-    ):
-        # same safety rule as the single-file save: a populated directory
-        # that is not one of our checkpoints is not ours to write into
-        raise IsADirectoryError(
-            f"checkpoint path {path} is a non-empty directory that is not a "
-            f"sharded checkpoint; refusing to write into it"
-        )
-
-    def _barrier(tag: str) -> None:
-        if jax.process_count() > 1:
-            from ..parallel import barrier
-
-            barrier(tag)
-
-    # stage everything in a sibling directory; the live path is only touched
-    # in the final swap
-    staging = path + ".saving"
-    old = path + ".old"
-    if jax.process_index() == 0:
-        import shutil
-
-        _recover_interrupted_swap(path, staging, old)
-        for leftover in (staging, old):  # debris from an interrupted save
-            if os.path.isdir(leftover):
-                shutil.rmtree(leftover)
-            elif os.path.isfile(leftover):
-                os.remove(leftover)
-    _barrier("sharded_ckpt_stage_clear")
-    os.makedirs(staging, exist_ok=True)
+    def _host_piece(data):
+        a = np.asarray(data)
+        return np.array(a, copy=True) if copy else a
 
     groups = {"model": params}
     if opt_state is not None:
@@ -471,14 +483,14 @@ def save_state_dict_sharded(
                         [int(s.start or 0), int(s.stop if s.stop is not None else dim)]
                         for s, dim in zip(shard.index, arr.shape)
                     ]
-                    data = np.asarray(shard.data)
+                    data = _host_piece(shard.data)
                     group_out.setdefault(key, []).append(
                         {"bounds": bounds, "data": data, "crc32": _crc32_of(data)}
                     )
             elif jax.process_index() == 0:
                 # host (numpy/python) leaf: replicated by construction,
                 # the primary owns it
-                a = np.asarray(arr)
+                a = _host_piece(arr)
                 group_out.setdefault(key, []).append(
                     {"bounds": [[0, d] for d in a.shape], "data": a,
                      "crc32": _crc32_of(a)}
@@ -523,6 +535,62 @@ def save_state_dict_sharded(
         _group_shards("optimizer") or _group_shards("model") or [1]
     )
 
+    return {
+        "manifest": manifest,
+        "owned": owned,
+        "global_step": int(global_step),
+    }
+
+
+def persist_state_sharded(path, snap: dict) -> None:
+    """Write a :func:`snapshot_state_sharded` snapshot to disk: staging
+    directory, per-process shard file, manifest-last, atomic swap — the
+    IO tail of a sharded save. Cross-process DEVICE-collective barriers
+    run here on multi-host worlds, which is why the Trainer only defers
+    this leg to the async persist thread on single-process runs (where
+    the barriers are no-ops): a background thread enqueueing
+    ``sync_global_devices`` concurrently with the main thread's training
+    collectives could reorder collective launches across hosts. A crash
+    anywhere in here leaves the previous good checkpoint at ``path``
+    untouched (manifest presence == completeness)."""
+    import jax
+
+    _fault("checkpoint.persist")
+    path = os.fspath(path)
+    manifest = snap["manifest"]
+    global_step = int(snap["global_step"])
+    if os.path.isdir(path) and os.listdir(path) and not os.path.exists(
+        os.path.join(path, _MANIFEST)
+    ):
+        # same safety rule as the single-file save: a populated directory
+        # that is not one of our checkpoints is not ours to write into
+        raise IsADirectoryError(
+            f"checkpoint path {path} is a non-empty directory that is not a "
+            f"sharded checkpoint; refusing to write into it"
+        )
+
+    def _barrier(tag: str) -> None:
+        if jax.process_count() > 1:
+            from ..parallel import barrier
+
+            barrier(tag)
+
+    # stage everything in a sibling directory; the live path is only touched
+    # in the final swap
+    staging = path + ".saving"
+    old = path + ".old"
+    if jax.process_index() == 0:
+        import shutil
+
+        _recover_interrupted_swap(path, staging, old)
+        for leftover in (staging, old):  # debris from an interrupted save
+            if os.path.isdir(leftover):
+                shutil.rmtree(leftover)
+            elif os.path.isfile(leftover):
+                os.remove(leftover)
+    _barrier("sharded_ckpt_stage_clear")
+    os.makedirs(staging, exist_ok=True)
+
     # each shard file still carries the step as defense-in-depth torn-save
     # detection (e.g. a checkpoint directory assembled by hand)
     shard_file = os.path.join(staging, f"shard-{jax.process_index():05d}.msgpack")
@@ -530,7 +598,7 @@ def save_state_dict_sharded(
     _atomic_write(
         shard_file,
         serialization.msgpack_serialize(
-            {"global_step": int(global_step), "shards": owned}
+            {"global_step": global_step, "shards": snap["owned"]}
         ),
     )
     # all shard files must land before the manifest exists anywhere
@@ -565,6 +633,55 @@ def save_state_dict_sharded(
     logger.info(
         f"Sharded state dict: process {jax.process_index()} wrote its shards "
         f"to {os.path.join(path, os.path.basename(shard_file))}."
+    )
+
+
+def save_state_dict_sharded(
+    path,
+    *,
+    params,
+    opt_state: Any = None,
+    loss_scale: Any = None,
+    global_step: int = 0,
+    extra: Optional[dict] = None,
+) -> None:
+    """Per-host sharded checkpoint (SURVEY §7 hard part (c)).
+
+    ``path`` becomes a DIRECTORY: every process writes exactly the array
+    shards it owns (``shard.replica_id == 0`` — each piece of data has one
+    canonical owner across the whole mesh, so replicated leaves are written
+    once and ZeRO/TP-sharded leaves are written piecewise by their holders);
+    the primary also writes a manifest with the tree structure and leaf
+    shapes/dtypes. Unlike :func:`save_state_dict`, NOTHING is gathered: peak
+    host memory is one local shard, not the full state — this is the path
+    that scales to genuinely sharded pod states.
+
+    Layout::
+
+        path/
+          manifest.msgpack          # format tag, step, leaf shapes/dtypes
+          shard-00000.msgpack       # this process's owned shards
+          shard-00001.msgpack       # (one file per process)
+
+    Atomicity: shards are written into a fresh sibling directory
+    (``path + '.saving'``); after a cross-process barrier confirms every
+    shard file landed, the primary writes the manifest LAST (manifest
+    presence therefore implies a complete checkpoint) and swaps the new
+    directory in. An interruption at any point leaves the previous good
+    checkpoint at ``path`` untouched.
+
+    Implemented as :func:`snapshot_state_sharded` (device -> host, the
+    only leg that must block training) followed by
+    :func:`persist_state_sharded` (serialize + write + swap) — the split
+    ``--async_checkpoint`` runs with the second leg on a background
+    thread.
+    """
+    persist_state_sharded(
+        path,
+        snapshot_state_sharded(
+            params=params, opt_state=opt_state, loss_scale=loss_scale,
+            global_step=global_step, extra=extra,
+        ),
     )
 
 

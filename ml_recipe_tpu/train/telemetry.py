@@ -128,10 +128,21 @@ class TrainTelemetry:
             "Steps flagged anomalous by the rolling median+MAD detector.")
         self.m_ckpt_save = m.histogram(
             "train_checkpoint_save_seconds",
-            "Checkpoint save durations.", CKPT_BUCKETS)
+            "Checkpoint save durations on the step critical path (sync "
+            "saves: full serialize+write; async saves: the blocking "
+            "device-to-host snapshot only).", CKPT_BUCKETS)
+        self.m_ckpt_persist = m.histogram(
+            "train_checkpoint_persist_seconds",
+            "Background persist durations of async checkpoint saves "
+            "(serialize+write overlapped with training — off the step "
+            "critical path).", CKPT_BUCKETS)
         self.m_ckpt_restore = m.histogram(
             "train_checkpoint_restore_seconds",
             "Checkpoint restore durations.", CKPT_BUCKETS)
+        self.m_zero1_buckets = m.gauge(
+            "train_zero1_buckets",
+            "Gradient buckets in the bucketed ZeRO-1 collective-overlap "
+            "plan (0 = monolithic exchange / overlap off).")
         self.m_heartbeat_age = m.gauge(
             "train_watchdog_heartbeat_age_seconds",
             "Seconds since the step watchdog last saw progress "
@@ -297,6 +308,53 @@ class TrainTelemetry:
         if self.flightrec is not None:
             self.flightrec.record(
                 "checkpoint_save", seconds=round(seconds, 6))
+
+    def observe_checkpoint_snapshot(self, seconds: float) -> None:
+        """Blocking leg of an ASYNC save (device->host snapshot + the
+        wait for any previous persist): this IS the save's critical-path
+        cost, so it feeds the same save histogram and checkpoint_save
+        badput the sync path does — the async win shows up as this number
+        shrinking while the persist time moves to the overlapped feed."""
+        self.m_ckpt_save.observe(seconds)
+        if self.goodput is not None:
+            self.goodput.note_checkpoint("save", seconds)
+        if self.flightrec is not None:
+            self.flightrec.record(
+                "ckpt_snapshot", seconds=round(seconds, 6))
+
+    def observe_checkpoint_persist(self, seconds: float,
+                                   stalled_s: float = 0.0) -> None:
+        """Background leg of an async save (serialize + write, called
+        from the persist thread on completion). Only the share that ran
+        while training proceeded is ledgered as checkpoint_overlapped_s:
+        ``stalled_s`` — time the main thread spent blocked waiting on
+        this persist (the next save's barrier, a restore, exit) — is
+        already on the critical path and booking it as overlap would
+        overstate the async win by exactly the stall."""
+        self.m_ckpt_persist.observe(seconds)
+        if self.goodput is not None:
+            self.goodput.note_checkpoint(
+                "save", max(0.0, seconds - stalled_s), overlapped=True
+            )
+        if self.flightrec is not None:
+            self.flightrec.record(
+                "ckpt_persist", seconds=round(seconds, 6),
+                stalled_s=round(stalled_s, 6))
+
+    def observe_zero1_buckets(self, buckets) -> None:
+        """Record the bucketed ZeRO-1 overlap plan (a list of
+        ``GradBucket``): the bucket count rides /metrics and the per-
+        bucket byte layout lands in the flight recorder, so a post-mortem
+        can attribute a collective stall to its bucket."""
+        buckets = list(buckets or [])
+        self.m_zero1_buckets.set(float(len(buckets)))
+        if self.flightrec is not None and buckets:
+            self.flightrec.record(
+                "zero1_bucket_plan",
+                buckets=len(buckets),
+                leaf_ranges=[[int(b.lo), int(b.hi)] for b in buckets],
+                bucket_bytes=[int(b.nbytes) for b in buckets],
+            )
 
     def observe_checkpoint_restore(self, seconds: float) -> None:
         self.m_ckpt_restore.observe(seconds)

@@ -29,7 +29,9 @@ TPU-first redesign (SURVEY.md §7):
 from __future__ import annotations
 
 import dataclasses
+import functools
 import logging
+import os
 import time
 from collections import defaultdict, deque
 from contextlib import nullcontext
@@ -59,6 +61,7 @@ from ..resilience.faults import fire as _fault
 from ..parallel import build_mesh, gather_to_host, make_global_array, shard_params
 from ..parallel.sharding import (
     is_single_device,
+    leaf_sizes,
     opt_state_bytes_per_chip,
     split_micro,
     zero_pad_tree,
@@ -206,6 +209,32 @@ class Trainer:
     shard_optimizer: bool = False
     zero_min_size: int = 16384      # leaves smaller than this stay replicated
 
+    # Bucketed ZeRO-1 collective overlap (--zero1_overlap off|bucketed):
+    # 'off' (default) keeps the monolithic flat-vector gradient exchange
+    # bit-exactly; 'bucketed' splits the flat f32 accumulation carry into
+    # size-targeted contiguous buckets (--zero1_bucket_mb each) so every
+    # bucket's reduce-scatter depends only on its own carry — XLA's
+    # latency-hiding scheduler can then interleave per-bucket collectives
+    # with the remaining update/backward compute instead of fusing one
+    # tail exchange behind the full flat vector (the DDP overlap
+    # discipline, arxiv 2004.13336). Same arithmetic: bucket vectors
+    # concatenate to the monolithic flat vector and the global-norm clip
+    # runs over that concatenation — trajectories agree with the
+    # unbucketed step to GSPMD reduction-order tolerance (the two
+    # programs partition differently), the same bound the
+    # zero1-vs-replicated pins hold.
+    zero1_overlap: Any = "off"
+    zero1_bucket_mb: float = 4.0
+
+    # Async overlapped checkpointing (--async_checkpoint): saves block
+    # only for the device->host snapshot; the serialize+write persist
+    # runs on a background thread (resilience/checkpoint_async.py) with
+    # the same crc32 + atomic-rename discipline as a sync save, a
+    # completion barrier before the next save/restore/exit, and the
+    # previous valid checkpoint staying newest if a crash lands
+    # mid-persist. Off (default) is the historical blocking save.
+    async_checkpoint: bool = False
+
     # Sharded checkpoint writes: each process saves only the array shards it
     # owns (directory layout) instead of gathering the full state to every
     # host for one single-file write — the save path that scales to
@@ -313,6 +342,26 @@ class Trainer:
         self.opt_sharding_mode = parse_optimizer_sharding(
             self.optimizer_sharding, shard_optimizer=self.shard_optimizer
         )
+
+        # collective-overlap mode: validated at construction (a typo must
+        # fail here, not silently train monolithic)
+        mode = str(self.zero1_overlap or "off").strip().lower()
+        if mode not in ("off", "bucketed"):
+            raise ValueError(
+                f"zero1_overlap must be 'off' or 'bucketed', got "
+                f"{self.zero1_overlap!r}"
+            )
+        self._zero1_overlap_mode = mode
+        self.zero1_bucket_count = 0   # set when the bucketed step is built
+
+        # async checkpointing: one single-flight background persist
+        # executor for the Trainer's lifetime (its wait() is the
+        # completion barrier before the next save / restore / exit)
+        self._async_ckpt = None
+        if self.async_checkpoint:
+            from ..resilience.checkpoint_async import AsyncCheckpointer
+
+            self._async_ckpt = AsyncCheckpointer()
 
         if self.debug:
             self.n_epochs = 2
@@ -1048,6 +1097,54 @@ class Trainer:
             or int(self.mesh.shape.get("model", 1)) <= 1
         )
 
+        # Bucketed ZeRO-1 collective overlap: the single flat carry makes
+        # every leaf's reduce-scatter wait on the FULL concatenated
+        # gradient (one fused tail exchange after backward); bucket_plan
+        # splits the carry into size-targeted contiguous runs whose
+        # exchanges are independently schedulable. Only meaningful where
+        # the flat carry would be used AND zero1 actually shards (a TP
+        # mesh already accumulates per-tensor — maximal independence).
+        bucket_plan = None
+        if self._zero1_overlap_mode == "bucketed" and zero_plan is not None:
+            if use_flat:
+                from ..parallel.sharding import zero1_bucket_plan
+
+                bucket_plan = zero1_bucket_plan(
+                    self.params, bucket_mb=self.zero1_bucket_mb
+                )
+                logger.info(
+                    "ZeRO-1 overlap: %d gradient bucket(s) at ~%.1f MB "
+                    "target (per-bucket reduce-scatter / all-gather "
+                    "independently schedulable).",
+                    len(bucket_plan), float(self.zero1_bucket_mb),
+                )
+            else:
+                logger.info(
+                    "zero1_overlap=bucketed on a tensor-parallel mesh: "
+                    "gradients already accumulate per-tensor (maximal "
+                    "per-leaf independence); bucketing is inert."
+                )
+        elif self._zero1_overlap_mode == "bucketed":
+            logger.info(
+                "zero1_overlap=bucketed without an active zero1 layout "
+                "(--optimizer_sharding off or a 1-chip mesh): nothing to "
+                "bucket; the monolithic step runs unchanged."
+            )
+        self.zero1_bucket_count = len(bucket_plan) if bucket_plan else 0
+        if self.telemetry is not None:
+            self.telemetry.observe_zero1_buckets(bucket_plan or [])
+        # static slice walk of the bucketed carry, plain host ints
+        # computed OUTSIDE the traced body: (bucket index, leaf index,
+        # offset of the leaf inside its bucket vector)
+        bucket_slices = None
+        if bucket_plan is not None:
+            static_sizes = leaf_sizes(self.params)
+            bucket_slices = [
+                (bi, k, sum(static_sizes[bk.lo:k]))
+                for bi, bk in enumerate(bucket_plan)
+                for k in range(bk.lo, bk.hi)
+            ]
+
         def train_step(params, opt_state, inputs, labels, step):
             if use_ls:
                 opt_state, ls_state = opt_state.inner, opt_state.ls
@@ -1078,7 +1175,7 @@ class Trainer:
             # removes it. On TP meshes the per-tensor path keeps each
             # gradient in its parameter's sharding.
             leaves, treedef = jax.tree_util.tree_flatten(params)
-            sizes = [int(np.prod(l.shape)) if l.ndim else 1 for l in leaves]
+            sizes = leaf_sizes(params)
             offsets = np.cumsum([0] + sizes)
             mask_leaves = (
                 jax.tree_util.tree_leaves(tmask) if tmask is not None else None
@@ -1103,7 +1200,43 @@ class Trainer:
                     ],
                 )
 
+            # Bucketed carry: one f32 vector PER BUCKET instead of one
+            # global flat vector. Buckets are contiguous leaf runs, so
+            # concatenating the bucket vectors reproduces the monolithic
+            # flat vector element for element — every op below runs the
+            # same arithmetic while each bucket's reduce-scatter depends
+            # only on its own carry. (The two programs still partition
+            # differently under GSPMD, so cross-replica reduction
+            # placement — and with it the trajectory — agrees to
+            # reduction-order tolerance, not bitwise.)
+            if bucket_plan is not None:
+                def flatten_grads_bucketed(tree):
+                    g_leaves = jax.tree_util.tree_leaves(tree)
+                    return tuple(
+                        jnp.concatenate(
+                            [
+                                jnp.ravel(g_leaves[k]).astype(jnp.float32)
+                                for k in range(bk.lo, bk.hi)
+                            ]
+                        )
+                        for bk in bucket_plan
+                    )
+
+                def unflatten_grads_bucketed(vecs):
+                    out = [
+                        jax.lax.dynamic_slice_in_dim(vecs[bi], off, sizes[k])
+                        .reshape(leaves[k].shape)
+                        .astype(leaves[k].dtype)
+                        for bi, k, off in bucket_slices
+                    ]
+                    return jax.tree_util.tree_unflatten(treedef, out)
+
             def acc_init():
+                if bucket_plan is not None:
+                    return tuple(
+                        jnp.zeros((int(b.size),), jnp.float32)
+                        for b in bucket_plan
+                    )
                 if use_flat:
                     return jnp.zeros((int(offsets[-1]),), jnp.float32)
                 return jax.tree_util.tree_map(
@@ -1111,6 +1244,11 @@ class Trainer:
                 )
 
             def acc_add(acc, grads):
+                if bucket_plan is not None:
+                    return tuple(
+                        a + f
+                        for a, f in zip(acc, flatten_grads_bucketed(grads))
+                    )
                 if use_flat:
                     return acc + flatten_grads(grads)
                 return jax.tree_util.tree_map(
@@ -1152,7 +1290,20 @@ class Trainer:
             # update is a no-op.
             grads = jax.tree_util.tree_map(lambda g: g * inv, acc_grads)
             if tmask is not None:
-                if use_flat:
+                if bucket_plan is not None:
+                    grads = tuple(
+                        jnp.where(
+                            jnp.concatenate(
+                                [
+                                    jnp.full((sizes[k],), bool(mask_leaves[k]))
+                                    for k in range(bk.lo, bk.hi)
+                                ]
+                            ),
+                            gvec, 0.0,
+                        )
+                        for bk, gvec in zip(bucket_plan, grads)
+                    )
+                elif use_flat:
                     mask_vec = jnp.concatenate(
                         [
                             jnp.full((sizes[i],), bool(mask_leaves[i]))
@@ -1171,19 +1322,30 @@ class Trainer:
                     lambda g: jnp.where(finite, g, 0.0), grads
                 )
             if clip_norm is not None and clip_norm > 0:
-                # optax.clip_by_global_norm semantics: g * c / max(norm, c)
-                gnorm = jnp.sqrt(
-                    sum(jnp.sum(g * g) for g in jax.tree_util.tree_leaves(grads))
-                )
+                # optax.clip_by_global_norm semantics: g * c / max(norm, c).
+                # Bucketed: the norm runs over the CONCATENATION of the
+                # bucket vectors — the same elements, same reduce shape as
+                # the monolithic flat vector, so the clip arithmetic is
+                # unchanged; the scalar is the only cross-bucket
+                # dependency (inherent to global-norm clipping), and it
+                # is one f32.
+                if bucket_plan is not None:
+                    full = jnp.concatenate(grads)
+                    gnorm = jnp.sqrt(jnp.sum(full * full))
+                else:
+                    gnorm = jnp.sqrt(
+                        sum(jnp.sum(g * g) for g in jax.tree_util.tree_leaves(grads))
+                    )
                 scale = clip_norm / jnp.maximum(gnorm, clip_norm)
                 grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
-            grads = (
-                unflatten_grads(grads)
-                if use_flat
-                else jax.tree_util.tree_map(
+            if bucket_plan is not None:
+                grads = unflatten_grads_bucketed(grads)
+            elif use_flat:
+                grads = unflatten_grads(grads)
+            else:
+                grads = jax.tree_util.tree_map(
                     lambda g, p: g.astype(p.dtype), grads, params
                 )
-            )
 
             if zero_plan is not None:
                 # ZeRO-1 update (the --optimizer_sharding zero1 hot path):
@@ -1859,6 +2021,12 @@ class Trainer:
         if self.debug:
             logger.info(f"Model was not saved to {path_} because of debug mode.")
             return
+        if self._async_ckpt is not None and self._async_supported():
+            return self._save_state_dict_async(path_)
+        if self._async_ckpt is not None:
+            # sync fallback still honors the single-flight contract: a
+            # previous async persist must land before this save writes
+            self.finish_pending_checkpoint()
         opt_state, ls_state = self._split_ls()
         # its own watchdog frame: the sharded save crosses process barriers,
         # and a peer that died mid-save must abort this host (for restart)
@@ -1897,7 +2065,114 @@ class Trainer:
         if self.telemetry is not None:
             self.telemetry.observe_checkpoint_save(time.perf_counter() - t0)
 
+    def _async_supported(self) -> bool:
+        """Async persist is restricted to configurations whose persist leg
+        is free of cross-process DEVICE collectives: a multi-host SHARDED
+        persist runs ``sync_global_devices`` barriers, and issuing those
+        from a background thread concurrently with the main thread's
+        train-step collectives can reorder collective launches across
+        hosts (pod deadlock) — and would arm watchdog frames on the
+        process-global LIFO stack from the wrong thread. Single-process
+        sharded persists skip the barriers entirely, and single-file
+        persists never had any; multi-host sharded saves fall back to the
+        sync path with a (once) log line."""
+        if not (self.sharded_checkpoint and self.process_count > 1):
+            return True
+        if not getattr(self, "_async_fallback_logged", False):
+            self._async_fallback_logged = True
+            logger.warning(
+                "--async_checkpoint with --sharded_checkpoint on a "
+                "multi-host world: the sharded persist crosses process "
+                "barriers, which must not run on a background thread "
+                "concurrently with training collectives — saving "
+                "synchronously instead."
+            )
+        return False
+
+    def _save_state_dict_async(self, path_):
+        """Async overlapped save (--async_checkpoint): block only for the
+        device->host snapshot (plus the completion barrier on any previous
+        persist), then serialize+write on the background thread with the
+        same crc32/atomic-rename discipline a sync save uses. The snapshot
+        deep-copies every leaf (``copy=True``) because the very next train
+        step DONATES the live buffers the gather would otherwise view."""
+        from .checkpoint import (
+            persist_state,
+            persist_state_sharded,
+            snapshot_state,
+            snapshot_state_sharded,
+        )
+
+        opt_state, ls_state = self._split_ls()
+        extra = {"opt_sharding": self.effective_opt_sharding}
+        t0 = time.perf_counter()
+        with self._watched(f"checkpoint save {path_}", scale=8.0), \
+                trace_mod.span("checkpoint_save", cat="train",
+                               args={"path": str(path_),
+                                     "step": self.global_step,
+                                     "async": True}):
+            # completion barrier BEFORE snapshotting anew: two persists
+            # must never interleave on one path, and a failed background
+            # persist surfaces here, not silently
+            self._async_ckpt.wait()
+            with trace_mod.span("ckpt_snapshot", cat="train",
+                                args={"step": self.global_step}):
+                if self.sharded_checkpoint:
+                    snap = snapshot_state_sharded(
+                        params=self.params, opt_state=opt_state,
+                        loss_scale=ls_state, global_step=self.global_step,
+                        extra=extra, copy=True,
+                    )
+                    persist = functools.partial(
+                        persist_state_sharded, os.fspath(path_), snap
+                    )
+                else:
+                    state = snapshot_state(
+                        params=self.params, opt_state=opt_state,
+                        loss_scale=ls_state, global_step=self.global_step,
+                        extra=extra, is_primary=self.is_primary, copy=True,
+                    )
+                    persist = (
+                        None if state is None
+                        else functools.partial(
+                            persist_state, os.fspath(path_), state
+                        )
+                    )
+        blocking = time.perf_counter() - t0
+        if self.telemetry is not None:
+            self.telemetry.observe_checkpoint_snapshot(blocking)
+        if persist is not None:
+            on_done = (
+                self.telemetry.observe_checkpoint_persist
+                if self.telemetry is not None else None
+            )
+            self._async_ckpt.submit(path_, persist, on_done=on_done)
+            logger.info(
+                "Async checkpoint: step %d snapshot blocked %.3fs; persist "
+                "to %s running in the background.",
+                self.global_step, blocking, path_,
+            )
+
+    def finish_pending_checkpoint(self, *, raise_errors: bool = True) -> None:
+        """Completion barrier for --async_checkpoint: block until the
+        in-flight background persist lands (no-op when async checkpointing
+        is off or idle). Must run before process exit and before a
+        checkpoint is handed to the supervisor for resume (the SIGTERM
+        path). ``raise_errors=False`` is for best-effort paths (an
+        exception already propagating, or an emergency save that a STALE
+        failure must not abort): the failure is logged at ERROR and
+        CONSUMED — a later barrier will not re-raise it."""
+        if self._async_ckpt is None:
+            return
+        with self._watched("checkpoint persist wait", scale=8.0):
+            self._async_ckpt.wait(raise_errors=raise_errors)
+
     def load_state_dict(self, path_):
+        if self._async_ckpt is not None:
+            # a restore must observe the last save durably on disk (and a
+            # background persist failure must surface before training
+            # resumes from possibly-stale state)
+            self.finish_pending_checkpoint()
         t0 = time.perf_counter()
         live_opt, live_ls = self._split_ls()
         with trace_mod.span("checkpoint_restore", cat="train",

@@ -134,6 +134,56 @@ def test_zero1_8way_matches_replicated(tmp_path):
     _assert_same_trajectory(_run(z), _run(off))
 
 
+def test_zero1_bucketed_overlap_matches_unbucketed(tmp_path):
+    """ISSUE-14 acceptance: ``--zero1_overlap bucketed`` runs the SAME
+    arithmetic as the monolithic zero1 step — bucket vectors concatenate
+    to the flat gradient element for element and the global-norm clip runs
+    over that concatenation — so the trajectory and final params must
+    agree to the same reduction-order tolerance the zero1-vs-replicated
+    pins hold (the two programs partition differently under GSPMD, which
+    moves cross-replica reduction placement by ulps; bitwise identity is
+    only promised for ``--zero1_overlap off``, which is the monolithic
+    code path verbatim). zero1_bucket_mb is set far below the model size
+    so the plan genuinely splits."""
+    b, _ = _make_trainer(tmp_path, mesh_spec="data:8", dropout=0.0,
+                         n_epochs=2, batch_split=2,
+                         optimizer_sharding="zero1", zero_min_size=0,
+                         zero1_overlap="bucketed", zero1_bucket_mb=0.001)
+    u, _ = _make_trainer(tmp_path, mesh_spec="data:8", dropout=0.0,
+                         n_epochs=2, batch_split=2,
+                         optimizer_sharding="zero1", zero_min_size=0)
+    run_b = _run(b)
+    assert b.zero1_bucket_count > 1, "bucket plan did not split"
+    _assert_same_trajectory(run_b, _run(u))
+
+
+def test_zero1_overlap_off_bit_matches_head(tmp_path):
+    """ISSUE-14 acceptance: ``--zero1_overlap off`` (the default) and
+    ``--async_checkpoint`` off are the pre-overlap code paths verbatim — a
+    trainer constructed with both flags explicitly off must produce a
+    trajectory bit-identical to one that never saw the flags."""
+    off, _ = _make_trainer(tmp_path, mesh_spec="data:8", dropout=0.0,
+                           n_epochs=2, batch_split=2,
+                           optimizer_sharding="zero1", zero_min_size=0,
+                           zero1_overlap="off", async_checkpoint=False)
+    default, _ = _make_trainer(tmp_path, mesh_spec="data:8", dropout=0.0,
+                               n_epochs=2, batch_split=2,
+                               optimizer_sharding="zero1", zero_min_size=0)
+    losses_o, params_o = _run(off)
+    losses_d, params_d = _run(default)
+    assert off.zero1_bucket_count == 0
+    assert len(losses_o) == len(losses_d) >= 4
+    assert losses_o == losses_d, (
+        "zero1_overlap-off loss trajectory not bit-identical"
+    )
+    for x, y in zip(
+        jax.tree_util.tree_leaves(params_o), jax.tree_util.tree_leaves(params_d)
+    ):
+        np.testing.assert_array_equal(
+            x, y, err_msg="zero1_overlap-off final params not bit-identical"
+        )
+
+
 def test_dp8_matches_single_device_with_threefry_dropout(tmp_path):
     """With the partitionable threefry PRNG, even the dropout masks are a
     function of logical index only — the full stochastic trajectory must be

@@ -620,6 +620,82 @@ def test_goodput_partition_is_exact():
 
 
 @pytest.mark.unit
+def test_goodput_checkpoint_overlapped_split():
+    """ISSUE-14: the blocking-vs-overlapped checkpoint split. An async
+    save's background persist (``overlapped: true`` checkpoint events)
+    accumulates into ``checkpoint_overlapped_s`` OUTSIDE the badput
+    partition — it ran CONCURRENTLY with productive steps, so booking it
+    as badput would double-count wall-clock. The partition stays exact
+    and checkpoint_save badput carries the blocking share only."""
+    events = [
+        {"ev": "run_start", "t": 0.0, "step": 0},
+        {"ev": "steps", "t": 4.0, "first_step": 0, "last_step": 3,
+         "steps": 4, "productive_s": 3.5, "data_wait_s": 0.0,
+         "compile_s": 0.0},
+        # blocking snapshot (critical path) + overlapped persist (under
+        # the next steps' device time)
+        {"ev": "checkpoint", "t": 4.1, "kind": "save", "seconds": 0.1},
+        {"ev": "checkpoint", "t": 5.0, "kind": "save", "seconds": 0.8,
+         "overlapped": True},
+        {"ev": "run_end", "t": 5.0, "step": 4},
+    ]
+    s = summarize_events(events)
+    assert s["badput_s"]["checkpoint_save"] == pytest.approx(0.1)
+    assert s["checkpoint_overlapped_s"] == pytest.approx(0.8)
+    # exactness holds WITHOUT the overlapped share: the 0.8s ran under
+    # the productive window, not on its own wall-clock
+    parts = s["productive_s"] + sum(s["badput_s"].values())
+    assert parts == pytest.approx(s["total_wall_s"], rel=1e-9)
+    assert set(s["badput_s"]) == set(BADPUT_CATEGORIES)
+
+    # writer side: note_checkpoint(overlapped=True) emits the marked event
+    ledger = GoodputLedger(None)
+    ledger.note_checkpoint("save", 0.05)
+    ledger.note_checkpoint("save", 0.5, overlapped=True)
+    s2 = ledger.summary()
+    assert s2["badput_s"]["checkpoint_save"] == pytest.approx(0.05)
+    assert s2["checkpoint_overlapped_s"] == pytest.approx(0.5)
+    assert "overlapped" in ledger.summary_message()
+
+
+@pytest.mark.unit
+def test_telemetry_async_checkpoint_observers(tmp_path):
+    """observe_checkpoint_snapshot feeds the save histogram + blocking
+    badput (it IS the critical-path save cost); observe_checkpoint_persist
+    feeds the persist histogram + the overlapped ledger field; both land
+    as ckpt_snapshot / ckpt_persist flight-recorder events; the bucket
+    plan lands as a zero1_bucket_plan event + gauge."""
+    from ml_recipe_tpu.parallel.collectives import GradBucket
+
+    ledger = GoodputLedger(None)
+    rec = FlightRecorder(str(tmp_path / "flightrec_p0.json"), flush_every=64)
+    tele = TrainTelemetry(goodput=ledger, flightrec=rec)
+    ledger.note_run_start(0)
+    tele.observe_checkpoint_snapshot(0.02)
+    tele.observe_checkpoint_persist(0.4)
+    tele.observe_zero1_buckets(
+        [GradBucket(0, 3, 1000, 4000), GradBucket(3, 5, 500, 2000)]
+    )
+
+    s = ledger.summary()
+    assert s["badput_s"]["checkpoint_save"] == pytest.approx(0.02)
+    assert s["checkpoint_overlapped_s"] == pytest.approx(0.4)
+
+    out = tele.registry.render()
+    assert "train_checkpoint_persist_seconds" in out
+    assert "train_zero1_buckets 2" in out
+
+    rec.dump("test")
+    _, doc = newest_flight_record(tmp_path)
+    kinds = [e["kind"] for e in doc["events"]]
+    assert "ckpt_snapshot" in kinds and "ckpt_persist" in kinds
+    plan = next(e for e in doc["events"] if e["kind"] == "zero1_bucket_plan")
+    assert plan["buckets"] == 2
+    assert plan["leaf_ranges"] == [[0, 3], [3, 5]]
+    assert plan["bucket_bytes"] == [4000, 2000]
+
+
+@pytest.mark.unit
 def test_goodput_crash_loop_resumes_reclassify_once():
     """A crash loop resuming repeatedly from the SAME checkpoint must
     reclassify each window's replayed tail exactly once — not pro-rate
