@@ -1267,6 +1267,20 @@ def main() -> None:
                         help="zero1: state leaves below this many elements "
                              "stay replicated (sharding them buys nothing "
                              "and costs collective latency).")
+    parser.add_argument("--mesh", type=str, default=None,
+                        help="train mode: device mesh axes for the timed "
+                             "step, e.g. 'data:8' or 'data:2,pipe:2' "
+                             "(same grammar as the trainer's --mesh). "
+                             "None = all visible devices on the data "
+                             "axis.")
+    parser.add_argument("--pipe_sweep_microbatches", type=str, default=None,
+                        help="train mode under a pipe-bearing --mesh: "
+                             "comma list of micro-batch counts (e.g. "
+                             "'1,2,4') to re-time at the same global "
+                             "batch; the JSON gains pipe_bubble_sweep "
+                             "with measured vs modeled (K-1)/(K-1+m) "
+                             "bubble fractions — the pipeline-efficiency "
+                             "instrument.")
     parser.add_argument("--quantize", type=str, default="off",
                         choices=["off", "int8"],
                         help="infer/serve modes: post-training int8 "
@@ -1306,11 +1320,17 @@ def main() -> None:
 
     from ml_recipe_tpu.losses import build_loss
     from ml_recipe_tpu.models import MODEL_PRESETS, QAModel
-    from ml_recipe_tpu.parallel import build_mesh
+    from ml_recipe_tpu.parallel import ParallelPlan
+    from ml_recipe_tpu.parallel.pipeline import (
+        modeled_bubble_fraction as _modeled_bubble,
+    )
     from ml_recipe_tpu.train import Trainer
 
     n_chips = len(jax.devices())
-    mesh = build_mesh()
+    # the declarative parallelism plan: the timed step runs under exactly
+    # the topology the trainer would (--mesh grammar shared)
+    plan = ParallelPlan.from_spec(getattr(args, "mesh", None))
+    mesh = plan.mesh
 
     cfg = MODEL_PRESETS[args.model]
     cfg = _widen_positions(cfg, args.seq_len)
@@ -1329,25 +1349,61 @@ def main() -> None:
         jax.random.key(0), np.zeros((1, 8), dtype=np.int32)
     )["params"]
 
-    trainer = Trainer(
-        model=model, params=params, loss=build_loss(TP()),
-        collate_fun=None, trainer_params=None,  # step built manually below
-        mesh=mesh, batch_split=args.batch_split, seed=0,
-        train_batch_size=args.global_batch, hbm_preflight=args.hbm_preflight,
-        optimizer_sharding=args.optimizer_sharding,
-        zero_min_size=args.zero_min_size,
-        zero1_overlap=args.zero1_overlap,
-        zero1_bucket_mb=args.zero1_bucket_mb,
-        async_checkpoint=args.async_checkpoint,
-    )
     # test-only Trainer skips optimizer construction; build it for the bench
     from ml_recipe_tpu.train.optim import build_optimizer
 
-    trainer.optimizer, trainer.scheduler, trainer._schedule_count = build_optimizer(
-        TP(), trainer.params, num_training_steps=10_000, max_grad_norm=None,
-        warmup_coef=0.0,
+    def _bench_trainer(batch_split, params_tree, *, hbm_preflight):
+        """ONE bench-trainer bootstrap for the main timed step AND the
+        pipe-bubble sweep — the sweep must characterize exactly the
+        optimizer-sharding configuration the user benched, only the
+        micro-batch count varies."""
+        tr = Trainer(
+            model=model, params=params_tree, loss=build_loss(TP()),
+            collate_fun=None, trainer_params=None,
+            mesh=mesh, batch_split=batch_split, seed=0,
+            train_batch_size=args.global_batch, hbm_preflight=hbm_preflight,
+            optimizer_sharding=args.optimizer_sharding,
+            zero_min_size=args.zero_min_size,
+            zero1_overlap=args.zero1_overlap,
+            zero1_bucket_mb=args.zero1_bucket_mb,
+            async_checkpoint=args.async_checkpoint,
+        )
+        tr.optimizer, tr.scheduler, tr._schedule_count = build_optimizer(
+            TP(), tr.params, num_training_steps=10_000, max_grad_norm=None,
+            warmup_coef=0.0,
+        )
+        tr.init_opt_state()
+        return tr
+
+    # --pipe_sweep_microbatches: parse + validate UP FRONT (a count that
+    # cannot split the global batch must fail before the main timed run,
+    # not minutes later inside _split_micro)
+    sweep_ms = None
+    if args.pipe_sweep_microbatches:
+        if plan.pipe_size <= 1:
+            print(
+                "WARNING: --pipe_sweep_microbatches set but the --mesh has "
+                "no pipe axis (> 1); the sweep is skipped — add e.g. "
+                "'pipe:2' to --mesh.",
+                file=sys.stderr,
+            )
+        else:
+            sweep_ms = sorted({
+                int(s) for s in args.pipe_sweep_microbatches.split(",")
+                if s.strip()
+            })
+            for m in sweep_ms:
+                if m < 1 or B % m or (B // m) % max(plan.data_size, 1):
+                    raise SystemExit(
+                        f"--pipe_sweep_microbatches {m}: counts must be "
+                        f">= 1 and split global batch {B} into micro-"
+                        f"batches divisible over the {plan.data_size}-way "
+                        f"data axis"
+                    )
+
+    trainer = _bench_trainer(
+        args.batch_split, params, hbm_preflight=args.hbm_preflight
     )
-    trainer.init_opt_state()
 
     # UNSPLIT host batch: the HBM pre-flight may raise batch_split, and the
     # micro split must follow whatever it decides
@@ -1450,6 +1506,65 @@ def main() -> None:
             goodput.note_checkpoint("save", ckpt_total_s - ckpt_blocking_s)
         goodput.note_run_end(step_i)
 
+        # pipe-bubble sweep (--pipe_sweep_microbatches, validated above):
+        # re-time the step at the same global batch with varying micro-
+        # batch counts; under the GPipe model T(m) = ideal * (m+K-1)/m,
+        # so the measured bubble should track (K-1)/(K-1+m) — decreasing
+        # as m grows. Runs AFTER note_run_end so its trainer builds and
+        # compiles never pollute the goodput partition of the benched
+        # configuration.
+        pipe_sweep = None
+        if sweep_ms:
+            from ml_recipe_tpu.data.bucketing import synthetic_qa_batch
+            from ml_recipe_tpu.parallel.pipeline import (
+                measured_bubble_fractions,
+                modeled_bubble_fraction,
+            )
+
+            sweep_in, sweep_lab = synthetic_qa_batch(B, L)
+            times = {}
+            for m in sweep_ms:
+                # fresh runtime-owned params per point (deterministic
+                # init): re-handing one host tree to several trainers
+                # aliases memory into donated buffers on the CPU runtime
+                # — the PR-8 heap-corruption class
+                tr_m = _bench_trainer(
+                    m,
+                    model.init(
+                        jax.random.key(0), np.zeros((1, 8), dtype=np.int32)
+                    )["params"],
+                    hbm_preflight=False,
+                )
+                step_m = tr_m._build_train_step()
+                di = tr_m._global_batch(
+                    tr_m._split_micro(sweep_in), leading_accum=True
+                )
+                dl = tr_m._global_batch(
+                    tr_m._split_micro(sweep_lab), leading_accum=True
+                )
+                p_m, o_m = tr_m.params, tr_m.opt_state
+                p_m, o_m, v_m = step_m(p_m, o_m, di, dl, 0)
+                float(v_m["loss"])  # compile + sync
+                best = float("inf")
+                for rep in range(3):
+                    t0 = time.perf_counter()
+                    p_m, o_m, v_m = step_m(p_m, o_m, di, dl, rep + 1)
+                    float(v_m["loss"])
+                    best = min(best, time.perf_counter() - t0)
+                times[m] = best
+            measured = measured_bubble_fractions(times, plan.pipe_size)
+            pipe_sweep = [
+                {
+                    "microbatches": m,
+                    "step_time_ms": round(times[m] * 1e3, 1),
+                    "bubble_measured": round(measured[m], 4),
+                    "bubble_modeled": round(
+                        modeled_bubble_fraction(plan.pipe_size, m), 4
+                    ),
+                }
+                for m in sweep_ms
+            ]
+
     # observability twins of the --metrics_port surface: step-time
     # percentiles over the measured windows + the slow-step detector run
     # over the same series (a thermal-throttled / noisy-neighbor window
@@ -1515,6 +1630,17 @@ def main() -> None:
                 "global_batch": args.global_batch,
                 # pre-flight may have raised this above --batch_split
                 "batch_split": trainer.batch_split,
+                # the declarative plan the step ran under: axis sizes,
+                # stranded-device count, and (when pipe > 1) the GPipe
+                # stage count + modeled bubble at the measured
+                # batch_split — the pipeline-efficiency instrument for
+                # the first pipe:2 TPU capture
+                "mesh_axes": plan.describe(),
+                "mesh_unused_devices": plan.unused_devices,
+                "pipe_stages": plan.pipe_size,
+                "pipe_bubble_fraction": round(_modeled_bubble(
+                    plan.pipe_size, trainer.batch_split), 4),
+                "pipe_bubble_sweep": pipe_sweep,
                 "hbm_preflight": trainer.preflight_report,
                 # optimizer-state layout + measured per-chip residency
                 # (zero1: ~1/N of the replicated footprint)

@@ -363,3 +363,92 @@ def check_tracer_leak(ctx: Context) -> Iterable[Finding]:
                         "use `jnp.where` / `jax.lax.cond` / "
                         "`jax.lax.while_loop`",
                     )
+
+
+# -- MLA009 hand-rolled-sharding ---------------------------------------------
+
+_SHARDING_CTORS = {"NamedSharding", "PartitionSpec"}
+_MLA009_EXEMPT_PREFIX = "ml_recipe_tpu/parallel/"
+
+
+def _mla009_in_scope(path: str) -> bool:
+    return (
+        path.startswith("ml_recipe_tpu/")
+        and not path.startswith(_MLA009_EXEMPT_PREFIX)
+    )
+
+
+def _sharding_ctor_names(src) -> Set[str]:
+    """Dotted call names that resolve to the jax.sharding constructors in
+    this file: ``from jax.sharding import NamedSharding [as X]`` binds the
+    bare name, and ``import jax.sharding as jsh`` / ``from jax import
+    sharding as sh`` bind ``<alias>.NamedSharding`` spellings."""
+    names: Set[str] = set()
+    module_aliases: Set[str] = set()
+    for n in ast.walk(src.tree):
+        if isinstance(n, ast.ImportFrom):
+            if n.module == "jax.sharding":
+                for a in n.names:
+                    if a.name in _SHARDING_CTORS:
+                        names.add(a.asname or a.name)
+            elif n.module == "jax":
+                for a in n.names:
+                    if a.name == "sharding":
+                        module_aliases.add(a.asname or a.name)
+        elif isinstance(n, ast.Import):
+            for a in n.names:
+                if a.name == "jax.sharding" and a.asname:
+                    module_aliases.add(a.asname)
+    for alias in module_aliases:
+        for ctor in _SHARDING_CTORS:
+            names.add(f"{alias}.{ctor}")
+    return names
+
+
+@register(
+    "MLA009", "hand-rolled-sharding", "error",
+    summary=(
+        "a `NamedSharding`/`PartitionSpec` constructed outside "
+        "`parallel/` — layouts must derive from the declarative "
+        "ParallelPlan (parallel/plan.py), not be re-hand-wired per "
+        "feature; legitimate low-level sites get an allowlist entry "
+        "with a reason"
+    ),
+    rationale=(
+        "ISSUE 15 retired the per-feature sharding duplication that "
+        "every parallelism PR (ring, ZeRO-1, bucketed overlap) had to "
+        "re-derive: trainer, predictor, serving engine, checkpoint "
+        "manifests and the HBM pre-flight all consume ONE ParallelPlan. "
+        "A stray hand-built spec silently diverges from the plan the "
+        "moment an axis is added — exactly the five-parallel-rewirings "
+        "failure mode the declarative mesh exists to prevent"
+    ),
+)
+def check_hand_rolled_sharding(ctx: Context) -> Iterable[Finding]:
+    from .engine import get_rule
+
+    rule = get_rule("MLA009")
+    for src in ctx.files:
+        if not _mla009_in_scope(src.path):
+            continue
+        local = _sharding_ctor_names(src)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = A.dotted(node.func)
+            if d is None:
+                continue
+            terminal = d.rsplit(".", 1)[-1]
+            if d in local or (
+                terminal in _SHARDING_CTORS
+                and (d == terminal or d.endswith("sharding." + terminal)
+                     or d.startswith("jax."))
+            ):
+                yield rule.finding(
+                    src, node,
+                    f"`{d}(...)` hand-builds a sharding outside parallel/ "
+                    f"— derive it from the ParallelPlan "
+                    f"(plan.named/batch_shardings/opt_state_shardings/"
+                    f"put_replicated), or allowlist a genuine low-level "
+                    f"site with a reason",
+                )

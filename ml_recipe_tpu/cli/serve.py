@@ -25,7 +25,7 @@ from pathlib import Path
 from ..compose import init_model
 from ..config.parser import get_model_parser, get_params, get_serve_parser
 from ..ops import autotune
-from ..parallel import build_mesh
+from ..parallel import ParallelPlan
 from ..utils.logging import get_logger, show_params
 
 
@@ -57,7 +57,9 @@ def main(params, model_params) -> int:
         model_params, checkpoint=params.checkpoint,
         quantize=getattr(params, "quantize", "off"),
     )
-    mesh = build_mesh(getattr(params, "mesh", None))
+    # one declarative plan from --mesh; the engine derives its bucket
+    # placements from it
+    mesh = ParallelPlan.from_spec(getattr(params, "mesh", None)).mesh
 
     engine = QAEngine(
         model,

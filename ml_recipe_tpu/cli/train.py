@@ -36,7 +36,7 @@ from ..config.parser import (
 )
 from ..data import RawPreprocessor
 from ..data.bucketing import parse_length_buckets
-from ..parallel import barrier, build_mesh, initialize_from_params, is_primary
+from ..parallel import ParallelPlan, barrier, initialize_from_params, is_primary
 from ..train import AccuracyCallback, MAPCallback, SaveBestCallback, Trainer
 from ..utils.logging import get_logger, show_params
 from ..utils.seed import set_seed
@@ -101,10 +101,15 @@ def _run_worker(params, model_params, watchdog) -> None:
         cache_dir=getattr(params, "autotune_cache", None),
     )
 
-    mesh = build_mesh(params.mesh)
+    # the declarative parallelism plan: built ONCE from --mesh; the
+    # trainer (and through it the ZeRO-1 planner, HBM pre-flight and
+    # checkpoint manifests) derives every sharding from it
+    plan = ParallelPlan.from_spec(params.mesh)
+    mesh = plan.mesh
     local_logger.warning(
         f"Process {jax.process_index()}/{jax.process_count()}. "
-        f"Mesh: {dict(zip(mesh.axis_names, mesh.devices.shape))}. "
+        f"Mesh: {plan.describe()} "
+        f"({plan.unused_devices} visible device(s) unused). "
         f"Global batch {params.train_batch_size} spans the whole data axis — "
         f"scale the learning rate for the GLOBAL batch, not per-device."
     )

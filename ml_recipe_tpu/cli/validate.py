@@ -22,7 +22,7 @@ from ..compose import init_collate_fun, init_model, init_validation_dataset
 from ..config.parser import get_model_parser, get_params, get_predictor_parser
 from ..data.bucketing import parse_length_buckets
 from ..infer import Predictor
-from ..parallel import build_mesh
+from ..parallel import ParallelPlan
 from ..utils.logging import get_logger, show_params
 
 
@@ -46,7 +46,9 @@ def main(params, model_params):
     predictor = Predictor(
         model,
         model_state,
-        mesh=build_mesh(getattr(params, "mesh", None)),
+        # one declarative plan from --mesh; the predictor derives its
+        # batch placement from it
+        mesh=ParallelPlan.from_spec(getattr(params, "mesh", None)).mesh,
         collate_fun=collate_fun,
         batch_size=params.batch_size,
         n_jobs=params.n_jobs,

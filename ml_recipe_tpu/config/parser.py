@@ -77,17 +77,58 @@ def cast_loss_scale(value: str):
     return float(value)
 
 
+# The ONE --mesh help string (both the trainer and the predictor/serve
+# parsers register the flag; two hand-maintained copies drifted — ISSUE 15).
+# Documents every first-class axis the ParallelPlan understands.
+MESH_HELP = (
+    "Device mesh axes as 'name:size' pairs, e.g. 'data:8', "
+    "'data:4,model:2', 'data:2,seq:4', or 'data:2,pipe:2'. Axes: "
+    "data = data parallelism (batch rows; gradients reduce over it, "
+    "ZeRO-1 shards optimizer state over it), seq = sequence/context "
+    "parallelism (ring attention), model = tensor parallelism "
+    "(attention heads / MLP width), pipe = pipeline parallelism "
+    "(contiguous encoder-layer stages on a GPipe micro-batch schedule "
+    "over the batch_split micro-batches). None = all visible devices "
+    "on the data axis."
+)
+
+
 def parse_mesh_spec(spec: Optional[str]) -> dict:
-    """Parse ``"data:8,model:1"`` / ``"data=8,model=1"`` into an ordered dict."""
+    """Parse ``"data:8,model:1"`` / ``"data=8,model=1"`` into an ordered
+    dict. Duplicate axis names and sizes < 1 are rejected HERE, with the
+    offending spec in the message — the alternative is a downstream
+    device-array reshape failure that names neither."""
     if not spec:
         return {}
-    axes = {}
+    axes: dict = {}
     for part in spec.replace("=", ":").split(","):
         part = part.strip()
         if not part:
             continue
-        name, _, size = part.partition(":")
-        axes[name.strip()] = int(size)
+        name, sep, size_s = part.partition(":")
+        name = name.strip()
+        if not name or not sep or not size_s.strip():
+            raise ValueError(
+                f"mesh spec {spec!r}: malformed entry {part!r} "
+                f"(expected 'axis:size')"
+            )
+        try:
+            size = int(size_s)
+        except ValueError:
+            raise ValueError(
+                f"mesh spec {spec!r}: axis {name!r} has non-integer size "
+                f"{size_s.strip()!r}"
+            ) from None
+        if name in axes:
+            raise ValueError(
+                f"mesh spec {spec!r}: duplicate axis {name!r}"
+            )
+        if size < 1:
+            raise ValueError(
+                f"mesh spec {spec!r}: axis {name!r} size must be >= 1, "
+                f"got {size}"
+            )
+        axes[name] = size
     return axes
 
 
@@ -576,8 +617,7 @@ def get_trainer_parser() -> ConfigArgumentParser:
     parser.add_argument("--dist_world_size", type=int, default=1,
                         help="Number of host processes.")
     parser.add_argument("--mesh", type=cast2(str), default=None,
-                        help="Device mesh axes, e.g. 'data:8' or 'data:4,model:2' or "
-                             "'data:2,seq:4'. None = all devices on the data axis.")
+                        help=MESH_HELP)
 
     # Fault tolerance (resilience/): supervised restart + watchdog + drills.
     parser.add_argument("--supervise", action="store_true",
@@ -819,8 +859,7 @@ def get_serve_parser() -> ConfigArgumentParser:
                         help="Sliding-window stride for request chunking.")
 
     parser.add_argument("--mesh", type=cast2(str), default=None,
-                        help="Device mesh axes, e.g. 'data:8'. None = all "
-                             "devices on the data axis.")
+                        help=MESH_HELP)
 
     parser.add_argument("--autotune", type=_str2bool, default=True,
                         help="Kernel-geometry autotuner during bucket "

@@ -48,7 +48,7 @@ from ..data.packing import (
     parse_pack_splitting,
     parse_sequence_packing,
 )
-from ..parallel import build_mesh, gather_to_host, make_global_array
+from ..parallel import ParallelPlan, build_mesh, gather_to_host, make_global_array
 from ..serve.bucketing import pad_trailing_batch
 from ..utils.pipeline import LaggedConsumer
 from .score import (
@@ -131,6 +131,10 @@ class Predictor:
         self.model = model
         self.params = params
         self.mesh = mesh if mesh is not None else build_mesh()
+        # the declarative parallelism plan: batch placement (and the
+        # data-axis arithmetic below) derives from it, not from
+        # per-feature mesh spelunking
+        self.plan = ParallelPlan.from_mesh(self.mesh)
 
         self.scores: dict = defaultdict(int)
         self.candidates: dict = {}
@@ -231,10 +235,7 @@ class Predictor:
         if length_buckets:
             max_len = getattr(self.collate_fun, "keywords", {}).get("max_seq_len")
             grid = parse_length_buckets(length_buckets, max_len)
-            data_size = int(
-                self.mesh.shape.get("data", 1)
-                if hasattr(self.mesh, "shape") else 1
-            )
+            data_size = self.plan.data_size
             self._seq_grid = grid
             self._bucket_batches = bucket_batch_sizes(
                 grid, self.batch_size * grid[-1], multiple=max(data_size, 1)
@@ -245,7 +246,8 @@ class Predictor:
             )
 
         logger.info(
-            f"Predictor uses mesh {dict(zip(self.mesh.axis_names, self.mesh.devices.shape))}. "
+            f"Predictor uses mesh {self.plan.describe()} "
+            f"({self.plan.unused_devices} visible device(s) unused). "
             f"Batch size: {self.batch_size}. #workers: {self.n_jobs}. "
             f"Buffer size: {self.buffer_size}. Set limit: {self.limit}."
         )

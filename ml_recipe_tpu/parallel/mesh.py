@@ -1,15 +1,20 @@
-"""Device mesh construction.
+"""Device mesh construction — the topology half of the declarative
+:class:`~.plan.ParallelPlan`.
 
-The reference's only topology concept is "world size × GPUs per node" for DDP
-(train.py:133-136). Here the topology is a named `jax.sharding.Mesh` with up
-to three axes:
+The topology is a named `jax.sharding.Mesh` with up to four first-class
+axes, declared ONCE via ``--mesh`` and consumed everywhere through the
+ParallelPlan (trainer, predictor, serving engine, ZeRO-1 planner, HBM
+pre-flight, checkpoint manifests all *derive* their shardings from it —
+no per-feature rewiring):
 
-- ``data``  — data parallelism (replaces DDP; gradients psum over this axis)
-- ``model`` — tensor parallelism over attention heads / MLP width (no
-  reference counterpart; SURVEY.md §2.3 stretch)
+- ``pipe``  — pipeline parallelism: contiguous encoder-layer stages on a
+  GPipe micro-batch schedule (parallel/pipeline.py)
+- ``data``  — data parallelism (batch rows; gradients reduce over this
+  axis, ZeRO-1 shards optimizer state over it)
 - ``seq``   — sequence/context parallelism for long inputs (ring attention)
+- ``model`` — tensor parallelism over attention heads / MLP width
 
-Axis sizes come from the ``--mesh`` flag ("data:4,model:2"); by default all
+Axis sizes come from the ``--mesh`` flag ("data:4,pipe:2"); by default all
 visible devices form one data axis. Works identically on real TPU meshes and
 the virtual 8-CPU-device test mesh.
 """
@@ -27,7 +32,10 @@ from jax.sharding import Mesh
 
 logger = logging.getLogger(__name__)
 
-AXIS_ORDER = ("data", "seq", "model")
+# pipe outermost (stages talk point-to-point, the cheapest links can carry
+# them), data next, model innermost so model groups land on neighbouring
+# devices — ICI-friendly (TorchTitan's pp > dp > tp ordering).
+AXIS_ORDER = ("pipe", "data", "seq", "model")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,8 +86,15 @@ def build_mesh(
             f"but {len(devices)} are visible."
         )
     if mesh_spec.size < len(devices):
-        logger.info(
-            f"Mesh uses the first {mesh_spec.size} of {len(devices)} visible devices."
+        # a loud warning, not an info line: stranded accelerators are paid
+        # for and idle — the count also surfaces as `mesh_unused_devices`
+        # in the HBM pre-flight report and the bench train JSON
+        logger.warning(
+            "Mesh %s uses only the first %d of %d visible devices — "
+            "%d device(s) are STRANDED (idle but allocated). Widen an "
+            "axis (--mesh) to cover them.",
+            ordered, mesh_spec.size, len(devices),
+            len(devices) - mesh_spec.size,
         )
         devices = devices[: mesh_spec.size]
 
@@ -91,3 +106,10 @@ def build_mesh(
 
 def local_device_count(mesh: Mesh) -> int:
     return len([d for d in mesh.devices.flat if d.process_index == jax.process_index()])
+
+
+def unused_device_count(mesh: Mesh) -> int:
+    """Visible devices the mesh leaves idle (``build_mesh`` warns about
+    them; pre-flight reports and bench JSON surface this count so stranded
+    chips are visible, not logged-and-lost)."""
+    return max(0, len(jax.devices()) - int(mesh.devices.size))

@@ -1,0 +1,383 @@
+"""Pipeline parallelism over the ``pipe`` mesh axis (GPipe schedule).
+
+The encoder's layer stack is partitioned into K contiguous stages placed
+on the ``pipe`` mesh dimension; the ``batch_split`` micro-batches (the
+SAME micro split the gradient-accumulation scan uses,
+``sharding.split_micro``) stream through the stages on a GPipe schedule:
+at tick t, stage k runs micro-batch ``t - k``, so stage k's forward on
+micro-batch i overlaps stage k+1's forward on micro-batch i-1. The whole
+schedule is ONE ``shard_map`` island inside the jitted train step:
+
+- each pipe rank executes only its own stage's contiguous layers per
+  tick (``lax.switch`` on the rank index; params stay replicated);
+- the per-tick activation hand-off to the next rank is a literal
+  ``lax.ppermute`` over the ``pipe`` axis — activations cross stage
+  boundaries point-to-point; rank 0 refills from the next micro-batch;
+- the backward pass is plain autodiff through the tick scan: the
+  ppermute transposes to the reverse permute, giving the mirrored
+  backward pipeline for free, and gradients accumulate across
+  micro-batches exactly as the sequential scan does (grad of the summed
+  micro losses == the summed micro grads), pinning the arithmetic
+  against the single-axis run.
+
+Schedule accounting: with K stages and m micro-batches the loop runs
+``m + K - 1`` ticks of which only ``m`` are useful per stage — the GPipe
+bubble fraction ``(K-1)/(K-1+m)`` (arxiv 1811.06965; MPMD pipelining,
+arxiv 2412.14374). :func:`modeled_bubble_fraction` /
+:func:`measured_bubble_fractions` are the bench's efficiency instrument.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Dict, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+# -- schedule accounting -----------------------------------------------------
+
+def modeled_bubble_fraction(stages: int, microbatches: int) -> float:
+    """GPipe bubble: the fraction of schedule ticks a stage spends idle,
+    ``(K-1)/(K-1+m)``. 0 for a single stage."""
+    stages = int(stages)
+    microbatches = max(1, int(microbatches))
+    if stages <= 1:
+        return 0.0
+    return (stages - 1) / (stages - 1 + microbatches)
+
+
+def measured_bubble_fractions(
+    step_times: Mapping[int, float], stages: int
+) -> Dict[int, float]:
+    """Measured bubble per micro-batch count from a step-time sweep.
+
+    Each measurement at m micro-batches estimates the ideal (bubble-free)
+    step time as ``T(m) * m / (m + K - 1)`` — under the GPipe model these
+    estimates agree across the sweep, so their median is the reference
+    ideal, and ``1 - ideal / T(m)`` is the measured bubble. A schedule
+    with NO real overlap (sequential stages) yields a near-constant
+    measured fraction instead of the decreasing ``(K-1)/(K-1+m)`` curve,
+    which is what the bench sweep (and its test) pins against.
+    """
+    stages = int(stages)
+    if stages <= 1 or not step_times:
+        return {int(m): 0.0 for m in step_times}
+    ideal = float(np.median([
+        t * m / (m + stages - 1) for m, t in step_times.items()
+    ]))
+    return {
+        int(m): max(0.0, 1.0 - ideal / float(t))
+        for m, t in step_times.items()
+    }
+
+
+def stage_layer_count(num_layers: int, stages: int) -> int:
+    """Layers per stage; the stack must split into K EQUAL contiguous
+    stages (unequal stages would make the slowest stage the tick clock
+    and silently waste the rest)."""
+    stages = int(stages)
+    if stages < 1:
+        raise ValueError(f"pipe axis size must be >= 1, got {stages}")
+    if num_layers % stages != 0:
+        raise ValueError(
+            f"--mesh pipe:{stages} needs the encoder depth to split into "
+            f"equal contiguous stages, but {num_layers} layers % {stages} "
+            f"!= 0; choose a pipe size dividing num_layers"
+        )
+    return num_layers // stages
+
+
+def validate_pipeline_plan(plan, model, *, batch_split: int) -> None:
+    """Fail at construction (not at trace time) on configurations the
+    pipeline runtime does not compose with yet."""
+    cfg = getattr(model, "cfg", None)
+    if cfg is None or not hasattr(cfg, "num_layers"):
+        raise ValueError(
+            "pipeline parallelism needs a layered encoder model "
+            "(model.cfg.num_layers); got a model without one"
+        )
+    stage_layer_count(cfg.num_layers, plan.pipe_size)
+    if plan.seq_size > 1:
+        raise NotImplementedError(
+            "--mesh with both seq (ring attention) and pipe axes is not "
+            "composable yet: ring's shard_map cannot nest inside the "
+            "vmapped stage compute"
+        )
+    if plan.model_size > 1:
+        raise NotImplementedError(
+            "--mesh with both model (tensor parallel) and pipe axes is "
+            "not composable yet: stage-stacked layer params drop the TP "
+            "dim specs"
+        )
+    if batch_split < 1:
+        raise ValueError(f"batch_split must be >= 1, got {batch_split}")
+
+
+# -- pipelined encoder forward ----------------------------------------------
+
+def make_pipeline_encoder(model, plan, *, batch_split: int,
+                          deterministic: bool,
+                          prng_impl: str = "threefry2x32"):
+    """Build ``encode(params, micro_inputs, base_key) -> (seq_out,
+    pooled)`` running the encoder trunk on the GPipe schedule.
+
+    ``params`` is the full (replicated) QAModel param tree;
+    ``micro_inputs`` the ``[G, B_micro, ...]`` micro-split input planes
+    the gradient-accumulation scan already uses (rows sharded over
+    ``data`` on dim 1). Outputs are ``[G, B_micro, L, H]`` sequence
+    states and ``[G, B_micro, (S,) H]`` pooled vectors — the QA heads
+    and the loss run on them exactly as on the sequential path.
+
+    The schedule is an EXPLICIT ``shard_map`` over the ``pipe`` axis
+    (MPMD discipline, arxiv 2412.14374): each pipe rank runs only its
+    own stage's layers per tick (``lax.switch`` on the rank index), the
+    per-tick activation hand-off is a literal ``lax.ppermute`` to the
+    next rank, and the collected last-stage outputs come back through
+    one masked psum. Nothing is left to the auto-partitioner's choices —
+    on the virtual CPU mesh, GSPMD's resharding of in-jit-stacked
+    replicated params onto a ``pipe``-sharded layout was observed to
+    MISCOMPUTE (see tests/test_parallel_plan.py parity pins), which is
+    exactly the class of silent wrongness the explicit formulation
+    removes. Rank 0 also evaluates the (cheap) embedding refill every
+    tick; other ranks discard it, so its gradient flows only once.
+
+    Dropout keys are pure functions of (base_key, micro index, global
+    layer index): deterministic and resume-stable, but a DIFFERENT
+    stream than the sequential path's flax module-path folding —
+    pipeline trajectories are pinned against single-axis runs with
+    dropout off (reduction-order tolerance), matching the DDP precedent
+    that never promised cross-topology dropout determinism.
+    """
+    import flax.linen as nn
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..models.encoder import Embeddings, EncoderLayer, _dense
+    from .sharding import DATA_AXIS, PIPE_AXIS
+
+    cfg = model.cfg
+    mesh = plan.mesh
+    K = int(plan.pipe_size)
+    G = int(batch_split)
+    S = stage_layer_count(cfg.num_layers, K)
+    T = G + K - 1
+
+    emb_mod = Embeddings(cfg, model.dtype, model.ln_impl)
+    layer_cls = EncoderLayer
+    if model.remat:
+        layer_cls = nn.remat(EncoderLayer, static_argnums=(3,))
+    layer_mod = layer_cls(cfg, model.dtype, model.attention_impl,
+                          model.mesh, model.ln_impl, quantize=model.quantize)
+    pooler_mod = _dense(model.quantize, cfg.hidden_size, name="pooler",
+                        dtype=model.dtype)
+
+    def encode(params, micro_inputs, base_key):
+        t_params = params["transformer"]
+        seg_starts = micro_inputs.get("segment_starts")
+        has_seg = micro_inputs.get("segment_ids") is not None
+        planes = {
+            k: micro_inputs[k]
+            for k in ("input_ids", "attention_mask", "token_type_ids",
+                      "position_ids", "segment_ids")
+            if micro_inputs.get(k) is not None
+        }
+        if "attention_mask" not in planes:
+            planes["attention_mask"] = jnp.ones_like(planes["input_ids"])
+        if "token_type_ids" not in planes:
+            planes["token_type_ids"] = jnp.zeros_like(planes["input_ids"])
+        # keys cross the shard_map boundary as raw uint32 data (extended
+        # key dtypes through shard_map are version-fragile)
+        kd = jax.random.key_data(base_key)
+
+        def body(t_params, planes, kd):
+            k_idx = jax.lax.axis_index(PIPE_AXIS)
+            is_first = k_idx == 0
+            base = jax.random.wrap_key_data(kd, impl=prng_impl)
+            input_ids = planes["input_ids"]
+            mask = planes["attention_mask"]
+            ttype = planes["token_type_ids"]
+            pos_ids = planes.get("position_ids")
+            seg_ids = planes.get("segment_ids")
+            B, Lseq = input_ids.shape[1], input_ids.shape[2]
+
+            def micro_key(i):
+                # i runs out of [0, G) on warmup/drain lanes — those keys
+                # (and the activations they drop) are garbage that never
+                # reaches a collected output
+                return jax.random.fold_in(base, i)
+
+            def take(x, i, *, keep=False):
+                return jax.lax.dynamic_index_in_dim(
+                    x, jnp.clip(i, 0, G - 1), 0, keepdims=keep
+                )
+
+            def embed_micro(i):
+                return emb_mod.apply(
+                    {"params": t_params["embeddings"]},
+                    take(input_ids, i), take(ttype, i),
+                    deterministic=deterministic,
+                    position_ids=(
+                        None if pos_ids is None else take(pos_ids, i)
+                    ),
+                    rngs={"dropout": jax.random.fold_in(micro_key(i), 0)},
+                )
+
+            def run_stage(kk, h, m, sg, micro_idx):
+                # stage kk = contiguous layers [kk*S, (kk+1)*S)
+                for s in range(S):
+                    li = kk * S + s
+                    key_l = jax.random.fold_in(
+                        micro_key(micro_idx), 1 + li
+                    )
+                    h = layer_mod.apply(
+                        {"params": t_params[f"layer_{li}"]}, h, m,
+                        deterministic, sg if has_seg else None,
+                        rngs={"dropout": key_l},
+                    )
+                return h
+
+            def stage(h, m, sg, micro_idx):
+                # each rank executes exactly ONE branch — its own stage
+                branches = [
+                    functools.partial(run_stage, kk) for kk in range(K)
+                ]
+                return jax.lax.switch(k_idx, branches, h, m, sg, micro_idx)
+
+            h0 = embed_micro(jnp.int32(0))
+            h = jnp.where(is_first, h0, jnp.zeros_like(h0))
+            m = jnp.where(is_first, take(mask, jnp.int32(0)),
+                          jnp.zeros_like(mask[0]))
+            # the segment plane rides the rotation as a dummy when
+            # packing is off (one [B, L] int buffer — cheap) so the
+            # carry/switch structure is static
+            seg_src = seg_ids if has_seg else mask
+            sg = jnp.where(is_first, take(seg_src, jnp.int32(0)),
+                           jnp.zeros_like(seg_src[0]))
+            out0 = jnp.zeros((G, B, Lseq, int(cfg.hidden_size)), h0.dtype)
+            perm = [(i, (i + 1) % K) for i in range(K)]
+
+            def tick(carry, t):
+                h, m, sg, out = carry
+                micro_idx = t - k_idx
+                y = stage(h, m, sg, micro_idx)
+                # collect the LAST stage's output. Before tick K-1 the
+                # write lands (clipped) on slot 0 with warmup garbage —
+                # tick K-1 overwrites it with micro-batch 0's true
+                # output, and every later slot is written exactly once
+                # at its true tick, so no per-tick select is needed
+                slot = jnp.clip(t - (K - 1), 0, G - 1)
+                out = jax.lax.dynamic_update_slice(
+                    out, y[None].astype(out.dtype), (slot, 0, 0, 0)
+                )
+                # the stage-boundary hand-off: activations (and their
+                # mask/segment planes) cross to rank k+1 via collective
+                # permute; rank 0 refills from the next micro-batch
+                nxt = t + 1
+                y_n = jax.lax.ppermute(y, PIPE_AXIS, perm)
+                m_n = jax.lax.ppermute(m, PIPE_AXIS, perm)
+                sg_n = jax.lax.ppermute(sg, PIPE_AXIS, perm)
+                h = jnp.where(is_first, embed_micro(nxt).astype(y_n.dtype),
+                              y_n)
+                m = jnp.where(is_first, take(mask, nxt), m_n)
+                sg = jnp.where(is_first, take(seg_src, nxt), sg_n)
+                return (h, m, sg, out), None
+
+            (_, _, _, out), _ = jax.lax.scan(
+                tick, (h, m, sg, out0), jnp.arange(T, dtype=jnp.int32)
+            )
+            # only rank K-1 collected real outputs; the masked psum is
+            # the one gather that returns them to every rank
+            out = out * (k_idx == K - 1).astype(out.dtype)
+            return jax.lax.psum(out, PIPE_AXIS)
+
+        seq_out = shard_map(
+            body, mesh,
+            in_specs=(P(), P(None, DATA_AXIS, None), P()),
+            out_specs=P(None, DATA_AXIS, None, None),
+            check_rep=False,
+        )(t_params, planes, kd)
+
+        # pooled output — the encoder tail (encoder.py): each row's [CLS]
+        # (or each packed segment's own [CLS]) through the pooler Dense;
+        # plain data-parallel compute outside the pipeline island
+        if seg_starts is None:
+            pool_src = seq_out[:, :, 0]
+        else:
+            pool_src = jnp.take_along_axis(
+                seq_out, seg_starts[..., None].astype(jnp.int32), axis=2
+            )
+        pooled = jnp.tanh(
+            pooler_mod.apply({"params": t_params["pooler"]}, pool_src)
+        )
+        return seq_out, pooled
+
+    return encode
+
+
+def apply_qa_heads(model, params, sequence_output, pooled_output,
+                   attention_mask, *, deterministic, dropout_rng,
+                   segment_ids=None, segment_starts=None):
+    """The QA heads on ONE micro-batch of (pipelined) encoder outputs —
+    mirrors the post-trunk body of ``QAModel.__call__`` (span logits with
+    pad masking, per-segment confinement when packed, classifier on the
+    dropped-out pooled vector, sigmoid regressors). Parameters are the
+    same head leaves, so the two paths are interchangeable; parity with
+    the sequential forward is pinned in tests/test_parallel_plan.py.
+    """
+    from ..models.encoder import _dense
+    from ..models.qa_model import _MASK_NEG
+    import flax.linen as nn
+
+    cfg = model.cfg
+    packed = segment_starts is not None
+
+    position_logits = _dense(
+        model.quantize, 2, name="position_outputs", dtype=model.dtype
+    ).apply({"params": params["position_outputs"]}, sequence_output)
+    start_logits = position_logits[..., 0]
+    end_logits = position_logits[..., 1]
+
+    pad_penalty = (1 - attention_mask).astype(jnp.float32) * _MASK_NEG
+    start_logits = start_logits.astype(jnp.float32) + pad_penalty
+    end_logits = end_logits.astype(jnp.float32) + pad_penalty
+
+    if packed:
+        S = segment_starts.shape[1]
+        seg_eq = (
+            segment_ids[:, None, :]
+            == (1 + jnp.arange(S, dtype=segment_ids.dtype))[None, :, None]
+        )
+        seg_penalty = jnp.where(seg_eq, 0.0, jnp.float32(_MASK_NEG))
+        start_logits = start_logits[:, None, :] + seg_penalty
+        end_logits = end_logits[:, None, :] + seg_penalty
+
+    cls_hidden = nn.Dropout(cfg.hidden_dropout_prob).apply(
+        {}, pooled_output, deterministic=deterministic,
+        rngs={"dropout": dropout_rng},
+    )
+    classifier_logits = _dense(
+        model.quantize, cfg.num_labels, name="classifier", dtype=model.dtype
+    ).apply({"params": params["classifier"]}, cls_hidden)
+
+    reg_start = nn.sigmoid(
+        _dense(model.quantize, 1, name="reg_start", dtype=model.dtype)
+        .apply({"params": params["reg_start"]}, pooled_output)
+    )[..., 0]
+    reg_end = nn.sigmoid(
+        _dense(model.quantize, 1, name="reg_end", dtype=model.dtype)
+        .apply({"params": params["reg_end"]}, pooled_output)
+    )[..., 0]
+
+    return {
+        "start_class": start_logits,
+        "end_class": end_logits,
+        "start_reg": reg_start.astype(jnp.float32),
+        "end_reg": reg_end.astype(jnp.float32),
+        "cls": classifier_logits.astype(jnp.float32),
+    }

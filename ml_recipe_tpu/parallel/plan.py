@@ -1,0 +1,160 @@
+"""Declarative parallelism plan — ONE source of truth for every layout.
+
+The operator declares the topology once (``--mesh data:N,seq:M,pipe:K``)
+and every consumer *derives* its shardings from the resulting
+:class:`ParallelPlan` instead of hand-wiring per-leaf layouts:
+
+- the trainer derives batch placement, param shardings, the ZeRO-1
+  optimizer-state layout and the pipeline stage layout;
+- the predictor and the serving engine derive batch placement;
+- the HBM pre-flight and ``bench.py`` report ``plan.describe()`` and
+  ``plan.unused_devices``;
+- checkpoint manifests record ``mesh_axes`` so a restore knows what
+  topology wrote them (reshard-on-restore stays shape-driven).
+
+This is the TorchTitan discipline (arxiv 2410.06511): a single mesh +
+per-feature sharding *derivation* is what makes 3D/4D parallelism
+composable instead of five parallel rewirings. graftlint rule MLA009
+enforces the flip side: no ``NamedSharding``/``PartitionSpec``
+construction outside ``parallel/``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import build_mesh, unused_device_count
+from .sharding import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+    ZeroLeafPlan,
+    batch_pspec,
+    batch_sharding,
+    is_single_device,
+    param_pspecs,
+    zero1_plan,
+    zero_pspecs,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelPlan:
+    """The declarative mesh plan: a named mesh plus derivation methods.
+
+    Construction: :meth:`from_spec` (the ``--mesh`` string) or
+    :meth:`from_mesh` (an already-built mesh). Both record how many
+    visible devices the mesh leaves stranded.
+    """
+
+    mesh: Mesh
+    unused_devices: int = 0
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_spec(cls, spec: Optional[str] = None, *,
+                  devices: Optional[Sequence] = None) -> "ParallelPlan":
+        mesh = build_mesh(spec, devices=devices)
+        return cls(mesh=mesh, unused_devices=unused_device_count(mesh))
+
+    @classmethod
+    def from_mesh(cls, mesh: Mesh) -> "ParallelPlan":
+        return cls(mesh=mesh, unused_devices=unused_device_count(mesh))
+
+    # -- topology ------------------------------------------------------------
+
+    def axis_size(self, name: str) -> int:
+        """Size of a mesh axis; 1 when the axis is absent (the identity
+        for every layout derivation — an absent axis shards nothing)."""
+        return int(self.mesh.shape.get(name, 1))
+
+    @property
+    def data_size(self) -> int:
+        return self.axis_size(DATA_AXIS)
+
+    @property
+    def seq_size(self) -> int:
+        return self.axis_size(SEQ_AXIS)
+
+    @property
+    def model_size(self) -> int:
+        return self.axis_size(MODEL_AXIS)
+
+    @property
+    def pipe_size(self) -> int:
+        return self.axis_size(PIPE_AXIS)
+
+    @property
+    def single_device(self) -> bool:
+        return is_single_device(self.mesh)
+
+    def describe(self) -> Dict[str, int]:
+        """``{axis: size}`` in mesh order — the spelling manifests, the
+        pre-flight report and bench JSON all record."""
+        return {
+            str(name): int(size)
+            for name, size in zip(self.mesh.axis_names, self.mesh.devices.shape)
+        }
+
+    # -- derived shardings ---------------------------------------------------
+
+    def named(self, spec: P) -> NamedSharding:
+        """A NamedSharding over this plan's mesh. The one constructor
+        call sites outside ``parallel/`` go through (MLA009)."""
+        return NamedSharding(self.mesh, spec)
+
+    def replicated(self) -> NamedSharding:
+        return self.named(P())
+
+    def put_replicated(self, tree):
+        """Place a host tree fully replicated over the mesh."""
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, self.replicated()), tree
+        )
+
+    def batch_spec(self, *, shard_seq: bool = False, ndim: int = 2) -> P:
+        return batch_pspec(self.mesh, shard_seq=shard_seq, ndim=ndim)
+
+    def batch_shardings(self, batch_tree, *, shard_seq: bool = False):
+        return batch_sharding(self.mesh, batch_tree, shard_seq=shard_seq)
+
+    def param_specs(self, params):
+        return param_pspecs(params, self.mesh)
+
+    def zero1(self, tree, *, min_size: int = 16384):
+        """The padding-aware per-leaf ZeRO-1 placement plan (over the
+        ``data`` axis; TP axes honored) — see ``sharding.zero1_plan``."""
+        return zero1_plan(tree, self.mesh, min_size=min_size)
+
+    def zero1_param_shardings(self, zplan):
+        """NamedSharding tree for a ZeRO-1 leaf-plan tree (the layout the
+        padded grads/params are constrained onto inside the train step)."""
+        return jax.tree_util.tree_map(
+            lambda z: self.named(z.spec), zplan,
+            is_leaf=lambda x: isinstance(x, ZeroLeafPlan),
+        )
+
+    def opt_state_shardings(self, state_shapes, *,
+                            zero1: bool, min_size: int = 16384):
+        """NamedSharding tree for an optimizer-state (shape) tree:
+        ZeRO-1 layout when ``zero1`` (each shardable leaf over ``data``),
+        otherwise the replicated-with-TP-rules layout. ONE derivation for
+        the trainer's ``init_opt_state``, the checkpoint reconciliation
+        and the layout-consistency tests."""
+        return jax.tree_util.tree_map(
+            lambda spec: self.named(spec),
+            zero_pspecs(
+                state_shapes, self.mesh,
+                # min_size=inf disables the data axis: TP rules still
+                # apply, everything else replicates (the non-ZeRO layout)
+                min_size=min_size if zero1 else math.inf,
+            ),
+        )
+

@@ -32,6 +32,7 @@ logger = logging.getLogger(__name__)
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
 
 
 # Tensor-parallel partition rules: (param-path regex -> PartitionSpec).

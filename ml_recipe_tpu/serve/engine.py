@@ -56,7 +56,7 @@ from ..data.chunking import (
 )
 from ..infer.score import OUT_KEYS, build_score_fn
 from ..ops import autotune
-from ..parallel import build_mesh, make_global_array
+from ..parallel import ParallelPlan, build_mesh, make_global_array
 # the HBM byte arithmetic is shared with Trainer.preflight_train_step — one
 # definition of "projected per-device bytes" for train and predict steps
 # (utils/hbm.py: the serving path must not import the training stack)
@@ -203,6 +203,10 @@ class QAEngine:
         self.tokenizer = tokenizer
         self.grid = grid
         self.mesh = mesh if mesh is not None else build_mesh()
+        # the declarative parallelism plan: every placement below (bucket
+        # sharding over data, the replicated small-bucket fallback)
+        # derives from it
+        self.plan = ParallelPlan.from_mesh(self.mesh)
         self.max_question_len = int(max_question_len)
         self.doc_stride = int(doc_stride)
         self._closed = False
@@ -416,18 +420,12 @@ class QAEngine:
                 ]
             )
             batch_axis = 1
-        data_size = int(dict(zip(self.mesh.axis_names,
-                                 self.mesh.devices.shape)).get("data", 1))
+        data_size = self.plan.data_size
         if packed.shape[batch_axis] % max(data_size, 1) == 0:
             if batch_axis == 0:
                 return make_global_array(packed, self.mesh)
             return make_global_array(packed, self.mesh, batch_axis=1)
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec
-
-        return jax.device_put(
-            packed, NamedSharding(self.mesh, PartitionSpec())
-        )
+        return self.plan.put_replicated(packed)
 
     def preflight_predict_step(
         self, bucket: Bucket, *, limit_bytes=None, compile_fn=None,
@@ -481,6 +479,10 @@ class QAEngine:
             # fit), and bench.py surfaces both fields in its JSON line
             "quantize": self.quantize,
             "quant_mem_bytes": param_bytes(self.params),
+            # plan topology, mirroring the trainer's HBM pre-flight
+            # report: stranded chips are visible, not logged-and-lost
+            "mesh_axes": self.plan.describe(),
+            "mesh_unused_devices": self.plan.unused_devices,
         }
         for bucket in list(self.grid):
             if hbm_preflight:

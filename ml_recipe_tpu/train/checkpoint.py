@@ -343,6 +343,10 @@ def peek_checkpoint_layout(path) -> Optional[dict]:
                 "opt_sharding": (manifest.get("extra") or {}).get(
                     "opt_sharding"
                 ),
+                # the saver's declarative mesh plan ({axis: size}), when it
+                # recorded one — the topology this checkpoint was written
+                # under; restores reshard onto any live plan regardless
+                "mesh_axes": (manifest.get("extra") or {}).get("mesh_axes"),
                 "groups": {g: len(leaves) for g, leaves in groups.items()},
             }
         with open(path, "rb") as fh:
@@ -353,6 +357,7 @@ def peek_checkpoint_layout(path) -> Optional[dict]:
             "process_count": 1,
             "shards": 1,
             "opt_sharding": state.get("opt_sharding"),
+            "mesh_axes": state.get("mesh_axes"),
             "groups": {
                 g: len(flatten_dict(state[g], keep_empty_nodes=True))
                 for g in ("model", "optimizer", "loss_scale")

@@ -35,12 +35,13 @@ import os
 import time
 from collections import defaultdict, deque
 from contextlib import nullcontext
+from types import SimpleNamespace
 from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..data.bucketing import BucketedBatch, BucketedDataLoader, synthetic_qa_batch
 from ..data.device_prefetch import DevicePrefetcher
@@ -59,6 +60,7 @@ from ..metrics import trace as trace_mod
 from ..metrics.trace import XplaneWindow
 from ..resilience.faults import fire as _fault
 from ..parallel import build_mesh, gather_to_host, make_global_array, shard_params
+from ..parallel.plan import ParallelPlan
 from ..parallel.sharding import (
     is_single_device,
     leaf_sizes,
@@ -329,6 +331,29 @@ class Trainer:
     def __post_init__(self):
         if self.mesh is None:
             self.mesh = build_mesh()
+
+        # The declarative parallelism plan (parallel/plan.py): every
+        # layout below — batch placement, param/opt-state shardings, the
+        # ZeRO-1 leaf plan, the pipeline stage layout, the manifest/
+        # pre-flight topology records — derives from this ONE object.
+        self.plan = ParallelPlan.from_mesh(self.mesh)
+        self.pipe_stages = self.plan.pipe_size
+        if self.pipe_stages > 1:
+            from ..parallel.pipeline import validate_pipeline_plan
+
+            validate_pipeline_plan(
+                self.plan, self.model, batch_split=self.batch_split
+            )
+            logger.info(
+                "Pipeline parallelism: %d stages x %d layers over the "
+                "pipe axis, GPipe schedule over %d micro-batch(es) "
+                "(modeled bubble %.1f%%).",
+                self.pipe_stages,
+                int(self.model.cfg.num_layers) // self.pipe_stages,
+                self.batch_split,
+                100.0 * (self.pipe_stages - 1)
+                / (self.pipe_stages - 1 + self.batch_split),
+            )
 
         self.process_index = jax.process_index()
         self.process_count = jax.process_count()
@@ -626,34 +651,24 @@ class Trainer:
             self._bundle_ls()
             return
 
-        import math
-
-        from ..parallel.sharding import ZeroLeafPlan, zero1_plan, zero_pspecs
-
         if use_zero:
-            plan = zero1_plan(
-                self.params, self.mesh, min_size=self.zero_min_size
+            zplan = self.plan.zero1(self.params, min_size=self.zero_min_size)
+            self._zero_plan = zplan
+            self._zero_param_shardings = self.plan.zero1_param_shardings(
+                zplan
             )
-            self._zero_plan = plan
-            self._zero_param_shardings = jax.tree_util.tree_map(
-                lambda z: NamedSharding(self.mesh, z.spec), plan,
-                is_leaf=lambda x: isinstance(x, ZeroLeafPlan),
-            )
-            init_fn = lambda p: self.optimizer.init(zero_pad_tree(p, plan))
+            init_fn = lambda p: self.optimizer.init(zero_pad_tree(p, zplan))
         else:
             self._zero_plan = None
             self._zero_param_shardings = None
             init_fn = self.optimizer.init
 
         state_shapes = jax.eval_shape(init_fn, self.params)
-        shardings = jax.tree_util.tree_map(
-            lambda spec: NamedSharding(self.mesh, spec),
-            zero_pspecs(
-                state_shapes, self.mesh,
-                # min_size=inf disables the data axis: TP rules still apply,
-                # everything else replicates (the non-ZeRO layout)
-                min_size=self.zero_min_size if use_zero else math.inf,
-            ),
+        # the one derivation of the optimizer-state layout (ZeRO-1 over
+        # the plan's data axis, or replicated-with-TP-rules) — shared with
+        # the layout-consistency tests and checkpoint reconciliation
+        shardings = self.plan.opt_state_shardings(
+            state_shapes, zero1=use_zero, min_size=self.zero_min_size
         )
         self._zero_shardings = shardings if use_zero else None
         self.opt_state = jax.jit(
@@ -870,6 +885,10 @@ class Trainer:
             "bytes_before": None,
             "bytes": None,
             "applied": False,
+            # plan topology: which axes the step runs under, and how many
+            # visible devices the mesh strands (idle but allocated)
+            "mesh_axes": self.plan.describe(),
+            "mesh_unused_devices": self.plan.unused_devices,
             # optimizer-state residency: under zero1 this is ~1/N of the
             # replicated footprint, which is exactly why the planner must
             # re-measure rather than keep raising batch_split for memory
@@ -982,6 +1001,8 @@ class Trainer:
             "batch_split": self.batch_split,
             "buckets": [],
             "applied": False,
+            "mesh_axes": self.plan.describe(),
+            "mesh_unused_devices": self.plan.unused_devices,
             "opt_sharding": self.effective_opt_sharding,
             "opt_state_bytes_per_chip": (
                 opt_state_bytes_per_chip(self.opt_state)
@@ -1105,7 +1126,19 @@ class Trainer:
         # the flat carry would be used AND zero1 actually shards (a TP
         # mesh already accumulates per-tensor — maximal independence).
         bucket_plan = None
-        if self._zero1_overlap_mode == "bucketed" and zero_plan is not None:
+        if (self._zero1_overlap_mode == "bucketed" and zero_plan is not None
+                and int(getattr(self, "pipe_stages", 1) or 1) > 1):
+            # the bucketed carry exists to let per-bucket exchanges
+            # overlap the sequential accumulation scan; the pipelined
+            # body produces the WHOLE gradient in one backward (inside
+            # the shard_map island), so there is no carry to interleave
+            # — run the monolithic flat exchange, like on TP meshes
+            logger.info(
+                "zero1_overlap=bucketed under pipeline parallelism: the "
+                "pipelined backward yields the full gradient at once "
+                "(no accumulation carry to overlap); bucketing is inert."
+            )
+        elif self._zero1_overlap_mode == "bucketed" and zero_plan is not None:
             if use_flat:
                 from ..parallel.sharding import zero1_bucket_plan
 
@@ -1145,35 +1178,15 @@ class Trainer:
                 for k in range(bk.lo, bk.hi)
             ]
 
-        def train_step(params, opt_state, inputs, labels, step):
-            if use_ls:
-                opt_state, ls_state = opt_state.inner, opt_state.ls
-            # Per-step dropout keys: pure function of (seed, step, micro-index).
-            base = jax.random.fold_in(
-                jax.random.key(self.seed, impl=self.prng_impl), step
-            )
-            keys = jax.random.split(base, batch_split)
+        pipe = int(getattr(self, "pipe_stages", 1) or 1) > 1
+        plan = self.plan
+        model_obj = self.model
 
-            def loss_fn(p, micro_in, micro_lab, key):
-                preds = model.apply(
-                    {"params": p}, **micro_in, deterministic=False,
-                    rngs={"dropout": key},
-                )
-                total, values = loss(preds, micro_lab)
-                if use_ls:
-                    # scale inside the grad; reported `values` stay unscaled
-                    return ls_lib.scale_loss(total, ls_state), values
-                return total, values
-
-            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-
-            # Gradients accumulate in f32. On data-only meshes they live as
-            # ONE flat vector: a per-tensor tree_map add in the scan carry
-            # costs ~2 kernel launches per parameter tensor per micro-batch
-            # (measured 28% of the bert-base step on v5e — launch-bound, the
-            # actual traffic is ~7ms); a single fused add + one carry buffer
-            # removes it. On TP meshes the per-tensor path keeps each
-            # gradient in its parameter's sharding.
+        def grad_ops(params):
+            """Trace-time helpers over the flattened param layout — ONE
+            definition of the accumulation layout (flat vector / bucketed
+            vectors / per-tensor tree), shared by the sequential and the
+            pipelined step bodies."""
             leaves, treedef = jax.tree_util.tree_flatten(params)
             sizes = leaf_sizes(params)
             offsets = np.cumsum([0] + sizes)
@@ -1203,12 +1216,13 @@ class Trainer:
             # Bucketed carry: one f32 vector PER BUCKET instead of one
             # global flat vector. Buckets are contiguous leaf runs, so
             # concatenating the bucket vectors reproduces the monolithic
-            # flat vector element for element — every op below runs the
+            # flat vector element for element — every consumer runs the
             # same arithmetic while each bucket's reduce-scatter depends
             # only on its own carry. (The two programs still partition
             # differently under GSPMD, so cross-replica reduction
             # placement — and with it the trajectory — agrees to
             # reduction-order tolerance, not bitwise.)
+            flatten_grads_bucketed = unflatten_grads_bucketed = None
             if bucket_plan is not None:
                 def flatten_grads_bucketed(tree):
                     g_leaves = jax.tree_util.tree_leaves(tree)
@@ -1255,39 +1269,53 @@ class Trainer:
                     lambda a, g: a + g.astype(jnp.float32), acc, grads
                 )
 
-            def micro_step(carry, xs):
-                g_acc, v_acc = carry
-                micro_in, micro_lab, key = xs
-                (_, values), grads = grad_fn(params, micro_in, micro_lab, key)
-                g_acc = acc_add(g_acc, grads)
-                v_acc = jax.tree_util.tree_map(jnp.add, v_acc, values)
-                return (g_acc, v_acc), None
+            def acc_from_tree(grads):
+                """One whole-batch gradient tree -> the accumulation
+                layout (the pipelined body produces the summed-over-micros
+                gradient in one grad call)."""
+                if bucket_plan is not None:
+                    return flatten_grads_bucketed(grads)
+                if use_flat:
+                    return flatten_grads(grads)
+                return jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32), grads
+                )
 
-            # values structure: probe with a zero-cost eval_shape-compatible init
-            v0 = jax.tree_util.tree_map(
-                lambda _: jnp.zeros((), jnp.float32),
-                loss.value_structure(),
+            ops = SimpleNamespace(
+                leaves=leaves, treedef=treedef, sizes=sizes, offsets=offsets,
+                mask_leaves=mask_leaves, flatten_grads=flatten_grads,
+                unflatten_grads=unflatten_grads,
+                flatten_grads_bucketed=flatten_grads_bucketed,
+                unflatten_grads_bucketed=unflatten_grads_bucketed,
+                acc_init=acc_init, acc_add=acc_add,
+                acc_from_tree=acc_from_tree,
             )
+            return ops
 
-            (acc_grads, values), _ = jax.lax.scan(
-                micro_step, (acc_init(), v0), (inputs, labels, keys)
-            )
-            inv = 1.0 / batch_split
-            values = jax.tree_util.tree_map(lambda v: v * inv, values)
+        inv = 1.0 / batch_split
 
+        def finish_step(params, opt_state, acc_grads, values, step,
+                        ls_state, ops):
+            """Everything after gradient accumulation: mean/mask/
+            loss-scale/clip on the accumulation layout, the (ZeRO-1)
+            optimizer update, lr bookkeeping — identical for both step
+            bodies, so the pipelined path cannot drift from the pinned
+            sequential arithmetic."""
             # Loss-scale unscale/finite-check and global-norm clipping run
-            # over the accumulated f32 gradients. ONE pipeline serves both
-            # accumulation layouts — `acc_grads` is either the flat vector
-            # (a single-leaf pytree: every op below is one fused kernel) or
-            # the per-tensor tree; the math is identical (the single-leaf
-            # global norm reduces to the flat formula). Semantics match
-            # torch clip_grad_norm_ over the OPTIMIZED params: frozen
-            # modules are zeroed first (where/static zeros, not multiply —
-            # a frozen module's inf/nan gradient must vanish rather than
-            # poison the norm or trip the finite check for params that are
-            # not even optimized), and overflow steps contribute zero grads
-            # so optimizer moments stay untouched (masked below) and the
-            # update is a no-op.
+            # over the accumulated f32 gradients. ONE pipeline serves
+            # every accumulation layout — `acc_grads` is the flat vector
+            # (a single-leaf pytree: every op below is one fused kernel),
+            # the bucket-vector tuple, or the per-tensor tree; the math is
+            # identical (the single-leaf global norm reduces to the flat
+            # formula). Semantics match torch clip_grad_norm_ over the
+            # OPTIMIZED params: frozen modules are zeroed first (where/
+            # static zeros, not multiply — a frozen module's inf/nan
+            # gradient must vanish rather than poison the norm or trip
+            # the finite check for params that are not even optimized),
+            # and overflow steps contribute zero grads so optimizer
+            # moments stay untouched (masked below) and the update is a
+            # no-op.
+            sizes, leaves, mask_leaves = ops.sizes, ops.leaves, ops.mask_leaves
             grads = jax.tree_util.tree_map(lambda g: g * inv, acc_grads)
             if tmask is not None:
                 if bucket_plan is not None:
@@ -1315,6 +1343,7 @@ class Trainer:
                     grads = jax.tree_util.tree_map(
                         lambda g, m: g if m else jnp.zeros_like(g), grads, tmask
                     )
+            finite = None
             if use_ls:
                 grads = ls_lib.unscale(grads, ls_state)
                 finite = ls_lib.all_finite(grads)
@@ -1339,9 +1368,9 @@ class Trainer:
                 scale = clip_norm / jnp.maximum(gnorm, clip_norm)
                 grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
             if bucket_plan is not None:
-                grads = unflatten_grads_bucketed(grads)
+                grads = ops.unflatten_grads_bucketed(grads)
             elif use_flat:
-                grads = unflatten_grads(grads)
+                grads = ops.unflatten_grads(grads)
             else:
                 grads = jax.tree_util.tree_map(
                     lambda g, p: g.astype(p.dtype), grads, params
@@ -1414,7 +1443,144 @@ class Trainer:
 
             return new_params, new_opt_state, values
 
-        return jax.jit(train_step, donate_argnums=(0, 1))
+        def train_step(params, opt_state, inputs, labels, step):
+            ls_state = None
+            if use_ls:
+                opt_state, ls_state = opt_state.inner, opt_state.ls
+            ops = grad_ops(params)
+            # Per-step dropout keys: pure function of (seed, step, micro-index).
+            base = jax.random.fold_in(
+                jax.random.key(self.seed, impl=self.prng_impl), step
+            )
+            keys = jax.random.split(base, batch_split)
+
+            def loss_fn(p, micro_in, micro_lab, key):
+                preds = model.apply(
+                    {"params": p}, **micro_in, deterministic=False,
+                    rngs={"dropout": key},
+                )
+                total, values = loss(preds, micro_lab)
+                if use_ls:
+                    # scale inside the grad; reported `values` stay unscaled
+                    return ls_lib.scale_loss(total, ls_state), values
+                return total, values
+
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+            # Gradients accumulate in f32. On data-only meshes they live as
+            # ONE flat vector: a per-tensor tree_map add in the scan carry
+            # costs ~2 kernel launches per parameter tensor per micro-batch
+            # (measured 28% of the bert-base step on v5e — launch-bound, the
+            # actual traffic is ~7ms); a single fused add + one carry buffer
+            # removes it. On TP meshes the per-tensor path keeps each
+            # gradient in its parameter's sharding. The layout helpers are
+            # shared with the pipelined body (grad_ops above).
+            def micro_step(carry, xs):
+                g_acc, v_acc = carry
+                micro_in, micro_lab, key = xs
+                (_, values), grads = grad_fn(params, micro_in, micro_lab, key)
+                g_acc = ops.acc_add(g_acc, grads)
+                v_acc = jax.tree_util.tree_map(jnp.add, v_acc, values)
+                return (g_acc, v_acc), None
+
+            # values structure: probe with a zero-cost eval_shape-compatible init
+            v0 = jax.tree_util.tree_map(
+                lambda _: jnp.zeros((), jnp.float32),
+                loss.value_structure(),
+            )
+
+            (acc_grads, values), _ = jax.lax.scan(
+                micro_step, (ops.acc_init(), v0), (inputs, labels, keys)
+            )
+            values = jax.tree_util.tree_map(lambda v: v * inv, values)
+            return finish_step(
+                params, opt_state, acc_grads, values, step, ls_state, ops
+            )
+
+        train_step_pipe = None
+        if pipe:
+            # Pipeline-parallel body (--mesh pipe:K): the encoder trunk
+            # runs the batch_split micro-batches through K contiguous
+            # layer stages on the GPipe schedule (parallel/pipeline.py);
+            # heads + loss run per micro-batch on the collected outputs,
+            # and the gradient of the summed micro losses IS the
+            # accumulated gradient the sequential scan produces — so the
+            # shared finish_step pins the update arithmetic against the
+            # single-axis run.
+            from ..parallel.pipeline import (
+                apply_qa_heads,
+                make_pipeline_encoder,
+            )
+
+            pipe_encode = make_pipeline_encoder(
+                model_obj, plan, batch_split=batch_split,
+                deterministic=False, prng_impl=self.prng_impl,
+            )
+            num_layers = int(model_obj.cfg.num_layers)
+
+            def train_step_pipe(params, opt_state, inputs, labels, step):
+                ls_state = None
+                if use_ls:
+                    opt_state, ls_state = opt_state.inner, opt_state.ls
+                ops = grad_ops(params)
+                base = jax.random.fold_in(
+                    jax.random.key(self.seed, impl=self.prng_impl), step
+                )
+
+                def loss_fn(p):
+                    seq_out, pooled = pipe_encode(p, inputs, base)
+                    v_acc = jax.tree_util.tree_map(
+                        lambda _: jnp.zeros((), jnp.float32),
+                        loss.value_structure(),
+                    )
+                    total = jnp.float32(0)
+                    for i in range(batch_split):
+                        micro_in = jax.tree_util.tree_map(
+                            lambda x: x[i], inputs
+                        )
+                        micro_lab = jax.tree_util.tree_map(
+                            lambda x: x[i], labels
+                        )
+                        am = micro_in.get("attention_mask")
+                        if am is None:
+                            am = jnp.ones_like(micro_in["input_ids"])
+                        preds = apply_qa_heads(
+                            model_obj, p, seq_out[i], pooled[i], am,
+                            deterministic=False,
+                            # head-dropout key: (base, micro, 1+num_layers)
+                            # — disjoint from the embed (0) and layer
+                            # (1..num_layers) folds the encoder uses
+                            dropout_rng=jax.random.fold_in(
+                                jax.random.fold_in(base, i), 1 + num_layers
+                            ),
+                            segment_ids=micro_in.get("segment_ids"),
+                            segment_starts=micro_in.get("segment_starts"),
+                        )
+                        t_i, values_i = loss(preds, micro_lab)
+                        total = total + t_i
+                        v_acc = jax.tree_util.tree_map(
+                            jnp.add, v_acc, values_i
+                        )
+                    if use_ls:
+                        # scaling the summed loss == scaling each micro
+                        # loss (linearity), the sequential path's
+                        # arithmetic
+                        total = ls_lib.scale_loss(total, ls_state)
+                    return total, v_acc
+
+                (_, values), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params)
+                values = jax.tree_util.tree_map(lambda v: v * inv, values)
+                acc_grads = ops.acc_from_tree(grads)
+                return finish_step(
+                    params, opt_state, acc_grads, values, step, ls_state,
+                    ops,
+                )
+
+        return jax.jit(
+            train_step_pipe if pipe else train_step, donate_argnums=(0, 1)
+        )
 
     def _build_eval_step(self):
         model, loss = self.model, self.loss
@@ -2005,10 +2171,7 @@ class Trainer:
             return
         ls_state = ls_lib.init_state(self._ls_init_scale, dynamic=self._ls_dynamic)
         if not is_single_device(self.mesh):
-            replicated = NamedSharding(self.mesh, P())
-            ls_state = jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, replicated), ls_state
-            )
+            ls_state = self.plan.put_replicated(ls_state)
         self.opt_state = ls_lib.OptStateWithLS(self.opt_state, ls_state)
 
     def _split_ls(self):
@@ -2016,6 +2179,16 @@ class Trainer:
         if isinstance(self.opt_state, ls_lib.OptStateWithLS):
             return self.opt_state.inner, self.opt_state.ls
         return self.opt_state, None
+
+    def _checkpoint_extra(self) -> dict:
+        """Topology record every checkpoint carries: the actual optimizer
+        layout and the plan's mesh axes — so ``peek_checkpoint_layout``
+        can report what topology wrote a checkpoint (restores stay
+        shape-driven and reshard onto any live plan)."""
+        return {
+            "opt_sharding": self.effective_opt_sharding,
+            "mesh_axes": self.plan.describe(),
+        }
 
     def save_state_dict(self, path_):
         if self.debug:
@@ -2035,7 +2208,7 @@ class Trainer:
         # path in particular), which dwarfs a step — a slow save must not be
         # misclassified as a hang and crash-looped. Barriers inside inherit
         # this budget (watchdog.arm nested-frame default).
-        extra = {"opt_sharding": self.effective_opt_sharding}
+        extra = self._checkpoint_extra()
         t0 = time.perf_counter()
         with self._watched(f"checkpoint save {path_}", scale=8.0), \
                 trace_mod.span("checkpoint_save", cat="train",
@@ -2104,7 +2277,7 @@ class Trainer:
         )
 
         opt_state, ls_state = self._split_ls()
-        extra = {"opt_sharding": self.effective_opt_sharding}
+        extra = self._checkpoint_extra()
         t0 = time.perf_counter()
         with self._watched(f"checkpoint save {path_}", scale=8.0), \
                 trace_mod.span("checkpoint_save", cat="train",

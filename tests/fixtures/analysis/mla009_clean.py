@@ -1,0 +1,18 @@
+"""MLA009 clean twin: layouts derive from the ParallelPlan."""
+
+import jax
+
+
+def place(batch, plan):
+    # the plan is the single source of truth — consumers never spell a
+    # PartitionSpec themselves
+    return jax.device_put(batch, plan.batch_shardings(batch))
+
+
+def replicate(tree, plan):
+    return plan.put_replicated(tree)
+
+
+def opt_layout(plan, state_shapes, min_size):
+    return plan.opt_state_shardings(state_shapes, zero1=True,
+                                    min_size=min_size)

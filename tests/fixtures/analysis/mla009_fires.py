@@ -1,0 +1,17 @@
+"""MLA009 firing fixture: hand-built shardings outside parallel/."""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def place(batch, mesh):
+    # both constructor spellings fire: the aliased PartitionSpec and the
+    # NamedSharding wrapping it
+    spec = P("data", None)
+    return jax.device_put(batch, NamedSharding(mesh, spec))
+
+
+def replicate(tree, mesh):
+    import jax.sharding as jsh
+
+    return jax.device_put(tree, jsh.NamedSharding(mesh, jsh.PartitionSpec()))

@@ -38,6 +38,7 @@ ALL_RULE_IDS = [r.id for r in iter_rules()]
 _FIXTURE_DEST = {
     "MLA004": "ml_recipe_tpu/data/packing.py",  # lockstep-path scoped
     "MLA008": "ml_recipe_tpu/metrics/state_writer.py",  # artifact-path scoped
+    "MLA009": "ml_recipe_tpu/train/layouts.py",  # outside-parallel/ scoped
 }
 
 

@@ -312,3 +312,41 @@ def test_sequence_packing_off_bit_matches_head(tmp_path):
         np.testing.assert_array_equal(
             x, y, err_msg="packing-off final params not bit-identical"
         )
+
+
+def test_pipe2_matches_data4(tmp_path):
+    """ISSUE-15 acceptance: ``--mesh data:2,pipe:2`` trains the SAME
+    trajectory as ``data:4`` at identical data order — the GPipe schedule
+    (shard_map stages + ppermute hand-off, parallel/pipeline.py)
+    accumulates gradients across micro-batches exactly as the sequential
+    scan, so only GSPMD reduction reordering separates the two runs (the
+    zero1-vs-replicated tolerance)."""
+    dp, _ = _make_trainer(tmp_path, mesh_spec="data:4", dropout=0.0,
+                          n_epochs=2, batch_split=4)
+    pipe, _ = _make_trainer(tmp_path, mesh_spec="data:2,pipe:2",
+                            dropout=0.0, n_epochs=2, batch_split=4)
+    assert pipe.pipe_stages == 2
+    _assert_same_trajectory(_run(dp), _run(pipe))
+
+
+def test_pipe2_zero1_both_overlap_modes_match_data4(tmp_path):
+    """ISSUE-15 acceptance: ZeRO-1 (both --zero1_overlap modes) runs
+    under a pipe-bearing mesh, deriving its layouts from the one
+    ParallelPlan, and stays within the zero1-vs-replicated tolerance of
+    the plain data:4 run. Bucketed overlap is INERT under pipe (the
+    pipelined backward yields the whole gradient at once — no
+    accumulation carry to interleave), so its bucket count is 0."""
+    ref, _ = _make_trainer(tmp_path, mesh_spec="data:4", dropout=0.0,
+                           n_epochs=2, batch_split=4)
+    ref_run = _run(ref)
+    z, _ = _make_trainer(tmp_path, mesh_spec="data:2,pipe:2", dropout=0.0,
+                         n_epochs=2, batch_split=4,
+                         optimizer_sharding="zero1", zero_min_size=0)
+    _assert_same_trajectory(ref_run, _run(z))
+    assert z.zero_enabled()
+    zb, _ = _make_trainer(tmp_path, mesh_spec="data:2,pipe:2", dropout=0.0,
+                          n_epochs=2, batch_split=4,
+                          optimizer_sharding="zero1", zero_min_size=0,
+                          zero1_overlap="bucketed", zero1_bucket_mb=0.001)
+    _assert_same_trajectory(ref_run, _run(zb))
+    assert zb.zero1_bucket_count == 0, "bucketing must be inert under pipe"

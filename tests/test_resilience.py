@@ -1116,6 +1116,9 @@ def test_manifest_records_shard_layout_and_peek(tmp_path):
         "process_count": 1,
         "shards": 8,
         "opt_sharding": "zero1",
+        # written without a trainer: no ParallelPlan topology was recorded
+        # (trainer saves stamp plan.describe() here — ISSUE-15)
+        "mesh_axes": None,
         "groups": {"model": 1, "optimizer": 2},
     }
     assert peek_checkpoint_layout(tmp_path / "absent.ch") is None
@@ -1231,8 +1234,17 @@ def test_zero1_checkpoint_survives_mesh_reshape(tmp_path):
 
     layout = peek_checkpoint_layout(tmp_path / "zero_reshape.ch")
     assert layout["shards"] == 4 and layout["opt_sharding"] == "zero1"
+    # the manifest records the saver's declarative plan (ISSUE-15)
+    assert layout["mesh_axes"] == {"data": 4}
 
     # shrink: N=4 -> M=2, still zero1
     assert "RESUMED_OK mesh=data:2 mode=zero1" in phase("data:2", "zero1")
+    # ISSUE-15 reshard drill: restore the data:4 save onto a PIPELINE-
+    # bearing plan (data:2,pipe:2) — the zero1 state crops/zero-fills
+    # onto the new data-axis padding and training continues on the GPipe
+    # schedule
+    assert "RESUMED_OK mesh=data:2,pipe:2 mode=zero1" in phase(
+        "data:2,pipe:2", "zero1"
+    )
     # and back to a replicated layout on a wider mesh
     assert "RESUMED_OK mesh=data:8 mode=off" in phase("data:8", "off")
