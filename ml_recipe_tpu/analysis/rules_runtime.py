@@ -331,3 +331,55 @@ def check_lock_discipline(ctx: Context) -> Iterable[Finding]:
                     f"exception on the success path skips the release; "
                     f"use `with {recv}:`",
                 )
+
+
+# -- MLA010 unguarded-coordination-read ---------------------------------------
+
+_MLA010_SCOPE = ("ml_recipe_tpu/resilience/",)
+
+# the ONE function allowed to json-parse coordination/sidecar documents:
+# it owns the bounded torn-read retry and the schema-version rejection
+_MLA010_GUARDED = {"read_coordination_json"}
+
+
+def _mla010_in_scope(path: str) -> bool:
+    return any(path.startswith(prefix) for prefix in _MLA010_SCOPE)
+
+
+@register(
+    "MLA010", "unguarded-coordination-read", "error",
+    summary=(
+        "a `json.load`/`json.loads` in `resilience/` outside "
+        "`coordination.read_coordination_json` — supervisor/coordination "
+        "JSON is read cross-host on shared filesystems, where a raw read "
+        "races mid-replace windows and skips the schema-version check"
+    ),
+    rationale=(
+        "PR 16's elastic supervisors classify a peer as DEAD from its "
+        "coordination file; one raw `json.load` there turns a transient "
+        "torn read into a spurious host-lost pod restart, and silently "
+        "accepts sidecars written by incompatible builds — every read "
+        "must go through the bounded-retry + schema-checked helper"
+    ),
+)
+def check_unguarded_coordination_read(ctx: Context) -> Iterable[Finding]:
+    from .engine import get_rule
+
+    rule = get_rule("MLA010")
+    for src in ctx.files:
+        if not _mla010_in_scope(src.path):
+            continue
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Call)
+                    and A.dotted(node.func) in ("json.load", "json.loads")):
+                continue
+            scope = A.enclosing_function(node)
+            if scope is not None and scope.name in _MLA010_GUARDED:
+                continue
+            yield rule.finding(
+                src, node,
+                f"raw `{A.dotted(node.func)}` of coordination/sidecar "
+                f"state in resilience/ — cross-host readers must go "
+                f"through coordination.read_coordination_json (bounded "
+                f"torn-read retry + schema-version rejection)",
+            )

@@ -103,8 +103,19 @@ def _run_worker(params, model_params, watchdog) -> None:
 
     # the declarative parallelism plan: built ONCE from --mesh; the
     # trainer (and through it the ZeRO-1 planner, HBM pre-flight and
-    # checkpoint manifests) derives every sharding from it
-    plan = ParallelPlan.from_spec(params.mesh)
+    # checkpoint manifests) derives every sharding from it. With
+    # --elastic on the requested mesh may no longer fit the live device
+    # set (a restart after host loss): the data axis shrinks, structural
+    # axes refuse (parallel/mesh.elastic_axes).
+    if getattr(params, "elastic", "off") != "off":
+        plan = ParallelPlan.elastic_from_spec(params.mesh)
+        if plan.shrunk:
+            local_logger.warning(
+                f"ELASTIC RESUME: mesh re-derived for the live device set: "
+                f"requested {plan.requested_axes} -> running {plan.describe()}."
+            )
+    else:
+        plan = ParallelPlan.from_spec(params.mesh)
     mesh = plan.mesh
     local_logger.warning(
         f"Process {jax.process_index()}/{jax.process_count()}. "
@@ -141,7 +152,7 @@ def _run_worker(params, model_params, watchdog) -> None:
     state = {"exporter": None}
     try:
         _run_instrumented(
-            params, model_params, watchdog, local_logger, mesh, data_rng,
+            params, model_params, watchdog, local_logger, plan, data_rng,
             state,
         )
     finally:
@@ -154,11 +165,30 @@ def _run_worker(params, model_params, watchdog) -> None:
             tracer.close()  # flush the span file even on a non-clean exit
 
 
-def _run_instrumented(params, model_params, watchdog, local_logger, mesh,
+def _run_instrumented(params, model_params, watchdog, local_logger, plan,
                       data_rng, state) -> None:
     import jax
 
+    mesh = plan.mesh
     exp_dir = params.dump_dir / params.experiment_name
+
+    if (
+        getattr(params, "elastic", "off") != "off"
+        and os.environ.get("MLRT_SUPERVISED")
+        and watchdog is not None
+    ):
+        # elastic child heartbeat: piggyback on the step watchdog's beat so
+        # the cross-host coordination file carries this child's last
+        # completed step at training cadence (peer supervisors read it as
+        # the straggler/liveness signal) — no second timer thread
+        from ..resilience.coordination import COORD_DIRNAME, write_child_heartbeat
+        from ..resilience.faults import current_host
+
+        _coord_dir = os.path.join(str(exp_dir), COORD_DIRNAME)
+        _host = current_host()
+        watchdog.add_on_beat(
+            lambda step: write_child_heartbeat(_coord_dir, _host, step=step)
+        )
     telemetry = None
     goodput = None
     flightrec = None
@@ -184,6 +214,12 @@ def _run_instrumented(params, model_params, watchdog, local_logger, mesh,
             str(exp_dir), process_index=jax.process_index(),
             capacity=getattr(params, "flightrec_events", 256),
         )
+        if plan.shrunk:
+            # the crash-loop diagnosis timeline must explain a topology
+            # change: this attempt runs NARROWER than the operator asked
+            flightrec.record(
+                "mesh_shrunk", old=plan.requested_axes, new=plan.describe(),
+            )
         if watchdog is not None:
             # a hang abort dumps the last-K-step timeline before the
             # watchdog's os._exit(87)

@@ -646,8 +646,31 @@ def get_trainer_parser() -> ConfigArgumentParser:
                         help="Fault-injection drill spec, e.g. "
                              "'ckpt.pre_manifest:kill@2!once;"
                              "loader.read:raise@1x3' "
-                             "(see resilience/faults.py for the grammar; "
+                             "(see resilience/faults.py for the grammar, "
+                             "including %%hostN host scoping; "
                              "also via $MLRT_FAULTS).")
+    parser.add_argument("--elastic", type=cast2(str), default="off",
+                        choices=["off", "on"],
+                        help="Elastic pod supervision (with --supervise): "
+                             "per-host supervisors coordinate through "
+                             "<exp_dir>/pod/ heartbeat files — a dead "
+                             "host's peers kill+restart their children "
+                             "immediately and resume on a re-derived "
+                             "smaller mesh (data axis shrinks; pipe/seq/"
+                             "model refuse). Default off: fixed-world "
+                             "supervision, byte-identical to before.")
+    parser.add_argument("--min_world", type=int, default=1,
+                        help="Elastic: abort (instead of shrinking further) "
+                             "when fewer live hosts remain — training "
+                             "degenerately narrow burns budget silently.")
+    parser.add_argument("--host_timeout", type=float, default=60.0,
+                        help="Elastic: seconds a peer host's heartbeat may "
+                             "age before it is declared lost and the pod "
+                             "restarts without it.")
+    parser.add_argument("--coord_poll", type=float, default=2.0,
+                        help="Elastic: seconds between coordination sweeps "
+                             "(heartbeat publish + peer reads) while the "
+                             "child runs.")
 
     # Observability plane (metrics/ + train/telemetry.py): everything off
     # by default — the off path is pinned bit-identical.

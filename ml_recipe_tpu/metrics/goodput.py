@@ -93,6 +93,11 @@ def summarize_events(events: List[dict], *, now: Optional[float] = None) -> dict
         "steps": 0,
         "recomputed_steps": 0,
         "attempts": 0,
+        # elastic transitions: hosts the pod lost over the run's lifetime
+        # (events carry no duration — the downtime they cause is already
+        # partitioned into restart_downtime/recompute; this is the COUNT
+        # the run report names the cause with)
+        "hosts_lost": 0,
         "events": len(events),
     }
     stamped = [e for e in events if isinstance(e.get("t"), (int, float))]
@@ -154,6 +159,8 @@ def summarize_events(events: List[dict], *, now: Optional[float] = None) -> dict
             badput[f"checkpoint_{kind}"] += float(e.get("seconds", 0.0))
         elif ev == "eval":
             badput["eval"] += float(e.get("seconds", 0.0))
+        elif ev == "host_lost":
+            summary["hosts_lost"] += 1
 
     total = max(0.0, t1 - t0)
     productive = sum(w["productive_s"] for w in windows)
@@ -317,6 +324,7 @@ class GoodputLedger:
             f"{ratio if ratio is None else format(ratio, '.3f')} — "
             f"{s['productive_s']:.1f}s productive of {s['total_wall_s']:.1f}s "
             f"wall over {s['attempts'] or 1} attempt(s), "
-            f"{s['recomputed_steps']} recomputed step(s); badput: "
-            f"{parts or 'none'}.{overlapped}"
+            f"{s['recomputed_steps']} recomputed step(s)"
+            + (f", {s['hosts_lost']} host(s) lost" if s.get("hosts_lost") else "")
+            + f"; badput: {parts or 'none'}.{overlapped}"
         )

@@ -17,6 +17,7 @@ from typing import Optional
 
 import jax
 
+from ..resilience.coordination import ELASTIC_WORLD_ENV
 from ..resilience.faults import fire as _fault
 from ..resilience.watchdog import current as _current_watchdog
 from ..resilience.watchdog import watched as _watched
@@ -24,6 +25,32 @@ from ..resilience.watchdog import watched as _watched
 logger = logging.getLogger(__name__)
 
 _initialized = False
+
+
+def elastic_world_override() -> Optional[tuple]:
+    """``(world_size, process_id)`` from :data:`ELASTIC_WORLD_ENV`
+    (``"<size>:<rank>"``), set per attempt by the elastic supervisor so a
+    restarted child joins the CURRENT live world instead of the
+    params-declared one. None when unset; malformed values are a hard
+    error — a child silently joining the wrong world is the one thing an
+    elastic restart must never do."""
+    raw = os.environ.get(ELASTIC_WORLD_ENV)
+    if not raw:
+        return None
+    try:
+        size_s, rank_s = raw.split(":")
+        size, rank = int(size_s), int(rank_s)
+    except ValueError:
+        raise ValueError(
+            f"malformed {ELASTIC_WORLD_ENV}={raw!r}; expected "
+            f"'<world_size>:<process_id>' (e.g. '2:0')."
+        ) from None
+    if size < 1 or not (0 <= rank < size):
+        raise ValueError(
+            f"inconsistent {ELASTIC_WORLD_ENV}={raw!r}: need "
+            f"world_size >= 1 and 0 <= process_id < world_size."
+        )
+    return size, rank
 
 
 def _strip_scheme(address: str) -> str:
@@ -76,7 +103,25 @@ def initialize_distributed(
 
 
 def initialize_from_params(params) -> None:
-    """Bootstrap from the trainer flags (reference names preserved)."""
+    """Bootstrap from the trainer flags (reference names preserved).
+
+    The elastic supervisor's per-attempt world override wins over the
+    params-declared topology: after a host loss the survivors re-form a
+    SMALLER world, and the flags still describe the original one."""
+    override = elastic_world_override()
+    if override is not None:
+        size, rank = override
+        logger.warning(
+            f"ELASTIC: world override {ELASTIC_WORLD_ENV} -> joining as "
+            f"process {rank}/{size} (params declared "
+            f"{getattr(params, 'dist_world_size', 1)})."
+        )
+        initialize_distributed(
+            coordinator_address=getattr(params, "dist_init_method", None),
+            num_processes=size,
+            process_id=rank,
+        )
+        return
     local_rank = getattr(params, "local_rank", -1)
     world_size = getattr(params, "dist_world_size", 1)
     if world_size > 1 and local_rank < 0:

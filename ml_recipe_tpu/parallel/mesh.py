@@ -65,6 +65,66 @@ class MeshSpec:
         return out
 
 
+class ElasticMeshError(ValueError):
+    """A requested mesh cannot be re-derived over the live device set —
+    a STRUCTURAL axis (pipe/seq/model) would have to change size."""
+
+
+def elastic_axes(
+    axes: Dict[str, int], n_devices: int, *, min_data: int = 1
+) -> Dict[str, int]:
+    """Shrink a requested axes dict onto ``n_devices`` live devices.
+
+    Only the DATA axis shrinks: pipeline stages hold disjoint layer
+    shards, and seq/model groups hold disjoint tensor shards — changing
+    any of those sizes changes what each device OWNS, which the
+    crop/zero-fill checkpoint reconciliation cannot express. The data
+    axis, by contrast, only replicates: narrowing it keeps every
+    parameter whole and reshapes ZeRO-1 optimizer shards, which
+    ``reconcile_state_shapes`` handles. Refusals are loud and specific —
+    an elastic restart that silently trained a different model shape
+    would be far worse than an abort.
+    """
+    requested = dict(axes)
+    total = math.prod(requested.values())
+    if total <= n_devices:
+        return requested
+    structural = {k: v for k, v in requested.items() if k != "data"}
+    fixed = math.prod(structural.values()) if structural else 1
+    if fixed > n_devices:
+        raise ElasticMeshError(
+            f"cannot shrink mesh {requested} onto {n_devices} device(s): "
+            f"the structural axes {structural} alone need {fixed} devices. "
+            f"Only the data axis shrinks elastically — pipe/seq/model "
+            f"change what each device OWNS (layer/tensor shards), which "
+            f"checkpoint reconciliation cannot re-derive. Relaunch with a "
+            f"smaller --mesh or restore the lost hosts."
+        )
+    new_data = n_devices // fixed
+    if new_data < max(1, int(min_data)):
+        raise ElasticMeshError(
+            f"cannot shrink mesh {requested} onto {n_devices} device(s): "
+            f"the data axis would narrow to {new_data}, below the floor of "
+            f"{min_data} — training that narrow is degenerate (see "
+            f"--min_world)."
+        )
+    out = {k: (new_data if k == "data" else v) for k, v in requested.items()}
+    if "data" not in out:
+        # a pure-structural request that happens to fit was returned above;
+        # here the request had no data axis AND does not fit — unreachable
+        # unless fixed > n_devices, already raised. Keep the guard anyway.
+        raise ElasticMeshError(
+            f"mesh {requested} has no data axis to shrink onto "
+            f"{n_devices} device(s)."
+        )
+    logger.warning(
+        "ELASTIC: shrinking mesh %s -> %s over %d live device(s) "
+        "(data axis %d -> %d; structural axes unchanged).",
+        requested, out, n_devices, requested.get("data", 1), new_data,
+    )
+    return out
+
+
 def build_mesh(
     spec: Optional[str] = None,
     *,

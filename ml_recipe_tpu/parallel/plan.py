@@ -28,7 +28,7 @@ from typing import Dict, Optional, Sequence
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import build_mesh, unused_device_count
+from .mesh import MeshSpec, build_mesh, elastic_axes, unused_device_count
 from .sharding import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -55,6 +55,10 @@ class ParallelPlan:
 
     mesh: Mesh
     unused_devices: int = 0
+    # the axes the OPERATOR asked for (--mesh), recorded by
+    # elastic_from_spec so `shrunk` can report a topology change; None for
+    # plans built by the fixed-world constructors
+    requested_axes: Optional[Dict[str, int]] = None
 
     # -- construction --------------------------------------------------------
 
@@ -67,6 +71,34 @@ class ParallelPlan:
     @classmethod
     def from_mesh(cls, mesh: Mesh) -> "ParallelPlan":
         return cls(mesh=mesh, unused_devices=unused_device_count(mesh))
+
+    @classmethod
+    def elastic_from_spec(cls, spec: Optional[str] = None, *,
+                          devices: Optional[Sequence] = None,
+                          min_data: int = 1) -> "ParallelPlan":
+        """``from_spec`` that SHRINKS instead of raising when the requested
+        mesh no longer fits the live device set (``--elastic on``): only
+        the data axis narrows (``mesh.elastic_axes``), structural axes
+        refuse loudly. Records the original request so ``shrunk`` (and the
+        mesh_shrunk flight-recorder event) can report the change."""
+        devices = list(devices if devices is not None else jax.devices())
+        requested = MeshSpec.from_string(spec, n_devices=len(devices)).ordered()
+        axes = elastic_axes(requested, len(devices), min_data=min_data)
+        mesh = build_mesh(devices=devices, axes=axes)
+        return cls(
+            mesh=mesh,
+            unused_devices=unused_device_count(mesh),
+            requested_axes=dict(requested),
+        )
+
+    @property
+    def shrunk(self) -> bool:
+        """True when this plan was elastically narrowed below the operator's
+        requested topology (always False for fixed-world plans)."""
+        return (
+            self.requested_axes is not None
+            and self.requested_axes != self.describe()
+        )
 
     # -- topology ------------------------------------------------------------
 

@@ -24,11 +24,23 @@ SIGTERM-to-checkpoint) live in :mod:`..train.checkpoint` and
 restarts, and proves the recovery paths work.
 """
 
-from .faults import FaultError, FaultPlan, fire, install_plan
+from .coordination import (
+    COORD_DIRNAME,
+    COORD_SCHEMA_VERSION,
+    ELASTIC_WORLD_ENV,
+    CoordinationSchemaError,
+    PodCoordinator,
+    read_coordination_json,
+    write_child_heartbeat,
+)
+from .faults import HOST_ENV, FaultError, FaultPlan, current_host, fire, install_plan
 from .supervisor import (
+    HOST_LOST,
+    POD_RESTART,
     PREEMPT_EXIT_CODE,
     STATE_FILENAME,
     Attempt,
+    ElasticSupervisor,
     RetryPolicy,
     Supervisor,
     SupervisorResult,
@@ -40,9 +52,18 @@ from .watchdog import WATCHDOG_EXIT_CODE, Watchdog
 
 __all__ = [
     "Attempt",
+    "COORD_DIRNAME",
+    "COORD_SCHEMA_VERSION",
+    "CoordinationSchemaError",
+    "ELASTIC_WORLD_ENV",
+    "ElasticSupervisor",
     "FaultError",
     "FaultPlan",
+    "HOST_ENV",
+    "HOST_LOST",
+    "POD_RESTART",
     "PREEMPT_EXIT_CODE",
+    "PodCoordinator",
     "RetryPolicy",
     "STATE_FILENAME",
     "Supervisor",
@@ -50,8 +71,11 @@ __all__ = [
     "WATCHDOG_EXIT_CODE",
     "Watchdog",
     "classify_exit",
+    "current_host",
     "fire",
     "install_plan",
     "peek_supervisor_state",
+    "read_coordination_json",
+    "write_child_heartbeat",
     "write_supervisor_state",
 ]

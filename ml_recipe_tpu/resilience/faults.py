@@ -9,7 +9,7 @@ scenario replays identically run after run: no randomness, no timing races.
 
 Spec grammar (semicolon-separated entries)::
 
-    site:kind[@hit][xcount][~seconds][!once]
+    site:kind[@hit][xcount][~seconds][!once][%hostN]
 
 - ``site``   one of :data:`KNOWN_SITES` (typos are a hard error — a drill
   that silently never fires is worse than no drill).
@@ -26,7 +26,15 @@ Spec grammar (semicolon-separated entries)::
 - ``!once``  fire at most once across PROCESS RESTARTS, tracked via a
   marker file under ``$MLRT_FAULT_STATE`` — the knob that makes
   kill-then-recover drills converge instead of crash-looping (without the
-  env var, ``!once`` is per-process only).
+  env var, ``!once`` is per-process only). Markers are keyed per host, so
+  a shared state dir never cross-suppresses hosts.
+- ``%hostN`` scope the spec to the process whose ``$MLRT_HOST`` equals N
+  (the elastic supervisor stamps every child with its host id) — what
+  makes multi-host chaos drills deterministic: ``trainer.step:kill@4%host1``
+  kills exactly host 1's child on its 4th step, nobody else's. A
+  malformed scope (``%h1``, ``%host``) is a hard parse error: a drill
+  that silently never fires is worse than no drill. Arrival counters
+  still advance on every host — only the ACTION is scoped.
 
 Plans come from ``--fault_plan`` (config/CLI) or the ``MLRT_FAULTS`` env
 var (read lazily on first :func:`fire`, so supervised child processes and
@@ -50,6 +58,21 @@ logger = logging.getLogger(__name__)
 
 FAULT_ENV = "MLRT_FAULTS"
 FAULT_STATE_ENV = "MLRT_FAULT_STATE"
+
+# This process's host id in a multi-host pod (the elastic supervisor sets
+# it on every child). Read lazily at fire time: %hostN scoping and the
+# per-host !once marker key both resolve against it; unset means host 0.
+HOST_ENV = "MLRT_HOST"
+
+
+def current_host() -> int:
+    """This process's pod host id (``$MLRT_HOST``, default 0)."""
+    raw = os.environ.get(HOST_ENV, "")
+    try:
+        return int(raw) if raw else 0
+    except ValueError:
+        logger.warning(f"Ignoring malformed ${HOST_ENV}={raw!r}; using host 0.")
+        return 0
 
 # Exit code of an injected `kill` — distinct from the watchdog's so the
 # supervisor's classification (and test assertions) can tell a drill kill
@@ -93,6 +116,7 @@ class FaultSpec:
     count: int = 1          # -1 = every arrival from `hit` on
     seconds: Optional[float] = None
     once: bool = False
+    host: Optional[int] = None   # %hostN scope; None = every host
 
     def active_at(self, n: int) -> bool:
         if n < self.hit:
@@ -101,9 +125,10 @@ class FaultSpec:
 
 
 _SPEC_RE = re.compile(
-    r"^(?P<site>[\w.]+):(?P<kind>\w+)(?P<rest>(?:@\d+|x(?:\d+|\*)|~[\d.]+|!once)*)$"
+    r"^(?P<site>[\w.]+):(?P<kind>\w+)(?P<rest>(?:@\d+|x(?:\d+|\*)|~[\d.]+|!once|%\w+)*)$"
 )
-_TOKEN_RE = re.compile(r"@\d+|x(?:\d+|\*)|~[\d.]+|!once")
+_TOKEN_RE = re.compile(r"@\d+|x(?:\d+|\*)|~[\d.]+|!once|%\w+")
+_HOST_SCOPE_RE = re.compile(r"^%host(\d+)$")
 
 
 def _parse_entry(entry: str) -> FaultSpec:
@@ -111,7 +136,7 @@ def _parse_entry(entry: str) -> FaultSpec:
     if m is None:
         raise ValueError(
             f"malformed fault spec {entry!r}; expected "
-            f"'site:kind[@hit][xcount][~seconds][!once]'"
+            f"'site:kind[@hit][xcount][~seconds][!once][%hostN]'"
         )
     site, kind, rest = m.group("site"), m.group("kind"), m.group("rest")
     if site not in KNOWN_SITES:
@@ -133,6 +158,15 @@ def _parse_entry(entry: str) -> FaultSpec:
             spec.seconds = float(tok[1:])
         elif tok == "!once":
             spec.once = True
+        elif tok.startswith("%"):
+            scope = _HOST_SCOPE_RE.match(tok)
+            if scope is None:
+                raise ValueError(
+                    f"malformed host scope {tok!r} in fault spec {entry!r}; "
+                    f"expected '%host<N>' as the LAST token (e.g. "
+                    f"'trainer.step:kill@4%host1')"
+                )
+            spec.host = int(scope.group(1))
     if spec.hit < 1:
         raise ValueError(f"fault spec {entry!r}: @hit is 1-based")
     return spec
@@ -182,8 +216,11 @@ class FaultPlan:
     def _marker(self, index: int, spec: FaultSpec) -> Optional[str]:
         if self.state_dir is None:
             return None
+        # keyed per host: elastic drills share one state dir across the
+        # whole pod, and host 0's kill must not suppress host 1's
         return os.path.join(
-            self.state_dir, f"fired-{index:02d}-{spec.site}.{spec.kind}"
+            self.state_dir,
+            f"fired-{index:02d}-{spec.site}.{spec.kind}.h{current_host()}",
         )
 
     def _already_fired(self, index: int, spec: FaultSpec) -> bool:
@@ -212,11 +249,14 @@ class FaultPlan:
         armed = self._by_site.get(site)
         if not armed:
             return
+        host = current_host()
         with self._lock:
             n = self._counters.get(site, 0) + 1
             self._counters[site] = n
             to_fire = []
             for index, spec in armed:
+                if spec.host is not None and spec.host != host:
+                    continue  # scoped to another host; counter still advanced
                 if not spec.active_at(n):
                     continue
                 if spec.once:

@@ -104,6 +104,9 @@ class Watchdog:
         self._fired = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._on_beat: Optional[Callable[[Optional[int]], None]] = None
+        self._on_beat_interval = 1.0
+        self._beat_emitted: Optional[float] = None  # monotonic of last emit
 
     # -- arming ----------------------------------------------------------------
 
@@ -137,6 +140,7 @@ class Watchdog:
                 top.label = label
             top.deadline = time.monotonic() + top.timeout
             self._last_beat = time.monotonic()
+        self._emit_beat()
 
     @contextmanager
     def watch(self, label: str, timeout: Optional[float] = None):
@@ -168,6 +172,35 @@ class Watchdog:
         with self._lock:
             self._last_step = int(step)
             self._last_beat = time.monotonic()
+        self._emit_beat()
+
+    def add_on_beat(
+        self, hook: Callable[[Optional[int]], None], *,
+        min_interval: float = 1.0,
+    ) -> None:
+        """Piggyback a liveness hook on the watchdog's OWN heartbeat: each
+        ``tick``/``note_progress`` may call ``hook(last_step)``, rate-
+        limited to one emit per ``min_interval`` seconds — how the elastic
+        child publishes its cross-host heartbeat file at training cadence
+        without a second timer thread. The hook runs OUTSIDE the lock (it
+        does IO) and its failures are contained: heartbeating degrades,
+        training never does."""
+        self._on_beat = hook
+        self._on_beat_interval = float(min_interval)
+
+    def _emit_beat(self) -> None:
+        hook = self._on_beat
+        if hook is None:
+            return
+        now = time.monotonic()
+        if (self._beat_emitted is not None
+                and now - self._beat_emitted < self._on_beat_interval):
+            return
+        self._beat_emitted = now
+        try:
+            hook(self._last_step)
+        except Exception:  # noqa: BLE001 - liveness IO must not hurt training
+            logger.exception("watchdog on_beat hook failed")
 
     def heartbeat_age(self) -> Optional[float]:
         """Seconds since the last sign of life (arm/tick/note_progress) —

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 import zlib
 from typing import Any, Optional
 
@@ -242,14 +243,32 @@ def _fold_piece_crcs(pieces) -> int:
     return crc
 
 
-def peek_global_step(path) -> Optional[int]:
+def peek_global_step(
+    path, *, retries: int = 0, retry_delay: float = 0.05
+) -> Optional[int]:
     """``global_step`` of the checkpoint at ``path`` without restoring any
     state, or None when there is no readable checkpoint there. The
     supervisor's progress probe: called between restart attempts, so it
     rolls an interrupted swap forward/back first (same as a load would)
     and treats ANY unreadable/torn checkpoint as absent rather than
     raising — an unreadable checkpoint cannot be resumed from, which is
-    exactly what None means."""
+    exactly what None means.
+
+    ``retries`` re-probes after ``retry_delay`` when the first read comes
+    back None: elastic supervisors peek checkpoints a PEER host may be
+    mid-swap on, and a transient swap window must not read as 'no
+    progress' (the fixed-world supervisor only probes its own files and
+    keeps the single-shot default)."""
+    step = _peek_global_step_once(path)
+    for _ in range(max(0, int(retries))):
+        if step is not None:
+            break
+        time.sleep(retry_delay)
+        step = _peek_global_step_once(path)
+    return step
+
+
+def _peek_global_step_once(path) -> Optional[int]:
     path = os.fspath(path)
     if not os.path.exists(path):
         _recover_interrupted_swap(path, path + ".saving", path + ".old")

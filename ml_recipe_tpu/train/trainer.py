@@ -2340,6 +2340,40 @@ class Trainer:
         with self._watched("checkpoint persist wait", scale=8.0):
             self._async_ckpt.wait(raise_errors=raise_errors)
 
+    def _warn_topology_change(self, path_) -> None:
+        """Name an elastic (or manual) topology change at restore time.
+
+        Sharded directories record the saver's ``mesh_axes`` in the
+        manifest; when they differ from the live plan the restore is a
+        cross-topology reshard — legitimate and supported (crop/zero-fill
+        reconciliation plus shape-driven resharding), but it must be LOUD:
+        the operator reading this log is deciding whether a shrunk pod is
+        still the run they want. Single-file checkpoints are skipped —
+        peeking one costs a full deserialize and they are replicated
+        saves, so there is no sharded layout to mismatch."""
+        if not os.path.isdir(os.fspath(path_)):
+            return
+        from .checkpoint import peek_checkpoint_layout
+
+        layout = peek_checkpoint_layout(path_)
+        saved = (layout or {}).get("mesh_axes")
+        live = self.plan.describe()
+        if not saved or dict(saved) == live:
+            return
+        logger.warning(
+            f"ELASTIC RESUME / topology change: checkpoint {path_} was "
+            f"saved under mesh {dict(saved)}, restoring onto {live}. "
+            f"Optimizer state is corner-cropped/zero-filled onto the live "
+            f"ZeRO-1 layout; the LR schedule is keyed to the GLOBAL batch "
+            f"and global_step, so it continues unchanged — at a smaller "
+            f"data axis each step consumes the same global batch over "
+            f"fewer devices (slower wall-clock, identical math)."
+        )
+        if self.telemetry is not None:
+            flightrec = getattr(self.telemetry, "flightrec", None)
+            if flightrec is not None:
+                flightrec.record("mesh_shrunk", old=dict(saved), new=live)
+
     def load_state_dict(self, path_):
         if self._async_ckpt is not None:
             # a restore must observe the last save durably on disk (and a
@@ -2362,6 +2396,7 @@ class Trainer:
                 time.perf_counter() - t0)
         if global_step is None:
             return
+        self._warn_topology_change(path_)
         if not self.drop_optimizer and live_opt is not None and opt_state is not None:
             # mesh-shape / sharding-mode portability: crop/zero-fill each
             # restored leaf onto the LIVE (possibly differently padded)

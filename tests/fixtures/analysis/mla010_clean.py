@@ -1,0 +1,26 @@
+"""MLA010 clean twin: coordination documents read through the guarded
+helper (bounded torn-read retry + schema-version rejection), and the
+helper itself — the ONE place a raw json.load is the implementation of
+the guard rather than a bypass of it."""
+
+import json
+import time
+
+
+def read_coordination_json(path, *, retries=3, sleep=time.sleep):
+    # clean: THE guarded reader — the json.load here is wrapped in the
+    # bounded retry and schema check every other call site must go through
+    for attempt in range(retries + 1):
+        try:
+            with open(path) as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            if attempt == retries:
+                return None
+            sleep(0.05)
+    return None
+
+
+def peek_peer(path):
+    # clean: peer state goes through the guarded reader
+    return read_coordination_json(path)

@@ -1,0 +1,19 @@
+"""MLA010 firing fixture (mapped under ml_recipe_tpu/resilience/ by the
+test): coordination/sidecar JSON parsed with raw json.load/json.loads —
+a cross-host reader racing a mid-replace window misreads a torn document
+as a dead host, and nothing checks the schema version."""
+
+import json
+
+
+def peek_peer(path):
+    # FIRES: raw json.load of a peer's coordination file — one torn read
+    # on a shared filesystem becomes a spurious host-lost classification
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def parse_sidecar(text):
+    # FIRES: json.loads of sidecar content skips the schema-version
+    # rejection an incompatible build's document must hit
+    return json.loads(text)

@@ -39,6 +39,7 @@ _FIXTURE_DEST = {
     "MLA004": "ml_recipe_tpu/data/packing.py",  # lockstep-path scoped
     "MLA008": "ml_recipe_tpu/metrics/state_writer.py",  # artifact-path scoped
     "MLA009": "ml_recipe_tpu/train/layouts.py",  # outside-parallel/ scoped
+    "MLA010": "ml_recipe_tpu/resilience/peer_view.py",  # resilience-scoped
 }
 
 

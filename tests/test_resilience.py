@@ -216,6 +216,36 @@ def test_watchdog_nested_frames_are_reentrant():
         wd.stop()
 
 
+def test_watchdog_on_beat_hook_is_rate_limited_and_contained(caplog):
+    """The elastic child's heartbeat rides the watchdog's own beat: rate-
+    limited to min_interval, handed the last noted step, and a hook
+    failure degrades heartbeating without touching training."""
+    import logging as logging_mod
+
+    fired = []
+    wd = _test_watchdog(30.0, fired)
+    beats = []
+    try:
+        wd.add_on_beat(beats.append, min_interval=0.2)
+        wd.note_progress(7)               # emits immediately
+        with wd.watch("epoch") as tick:
+            tick("fast")                  # inside the interval: suppressed
+            time.sleep(0.25)
+            tick("later")                 # interval elapsed: emits again
+        assert beats == [7, 7]
+
+        def boom(step):
+            raise RuntimeError("heartbeat disk full")
+
+        wd.add_on_beat(boom, min_interval=0.0)
+        with caplog.at_level(logging_mod.ERROR):
+            wd.note_progress(8)           # must not raise
+        assert "on_beat hook failed" in caplog.text
+    finally:
+        wd.stop()
+    assert fired == []
+
+
 def test_watchdog_notes_last_step(capsys):
     fired = []
     wd = _test_watchdog(0.08, fired)
@@ -1248,3 +1278,691 @@ def test_zero1_checkpoint_survives_mesh_reshape(tmp_path):
     )
     # and back to a replicated layout on a wider mesh
     assert "RESUMED_OK mesh=data:8 mode=off" in phase("data:8", "off")
+
+
+# ---------------------------------------------------------------------------
+# Elastic coordination plane (ISSUE 16): guarded reads, schema versioning
+# ---------------------------------------------------------------------------
+
+
+def test_read_coordination_json_absent_is_immediate(tmp_path):
+    """Absence is a protocol state (a host that has not published yet),
+    not an error to retry: no sleeps, None now."""
+    from ml_recipe_tpu.resilience.coordination import read_coordination_json
+
+    sleeps = []
+    got = read_coordination_json(
+        tmp_path / "host-001.json", sleep=sleeps.append
+    )
+    assert got is None and sleeps == []
+
+
+def test_read_coordination_json_retries_torn_read(tmp_path):
+    """A torn document (shared-FS mid-replace window) heals within the
+    retry budget: the doc comes back, never a spurious 'absent'."""
+    from ml_recipe_tpu.resilience.coordination import (
+        COORD_SCHEMA_VERSION, read_coordination_json,
+    )
+
+    path = tmp_path / "host-001.json"
+    path.write_text('{"schema": 1, "status": "runn')  # mid-replace torn
+    sleeps = []
+
+    def sleep(s):
+        sleeps.append(s)
+        path.write_text(
+            '{"schema": %d, "status": "running"}' % COORD_SCHEMA_VERSION
+        )
+
+    got = read_coordination_json(path, sleep=sleep)
+    assert got == {"schema": COORD_SCHEMA_VERSION, "status": "running"}
+    assert len(sleeps) == 1
+    # backoff grows when the tear persists longer
+    path.write_text("garbage")
+    delays = []
+
+    def sleep2(s):
+        delays.append(s)
+        if len(delays) == 2:
+            path.write_text('{"schema": %d}' % COORD_SCHEMA_VERSION)
+
+    assert read_coordination_json(path, sleep=sleep2) is not None
+    assert delays == [0.05, 0.1]
+
+
+def test_read_coordination_json_degrades_after_budget(tmp_path):
+    """Persistent garbage degrades to None (treated as absent) after the
+    bounded budget — never an exception into the supervision loop."""
+    from ml_recipe_tpu.resilience.coordination import read_coordination_json
+
+    path = tmp_path / "host-001.json"
+    path.write_text("not json at all")
+    sleeps = []
+    assert read_coordination_json(path, retries=2, sleep=sleeps.append) is None
+    assert len(sleeps) == 2  # retries, then gave up on the final attempt
+
+
+def test_read_coordination_json_rejects_schema_mismatch(tmp_path):
+    """A document from an incompatible build fails LOUDLY at first read —
+    a pod where half the hosts run an older sidecar format must not
+    half-coordinate."""
+    from ml_recipe_tpu.resilience.coordination import (
+        CoordinationSchemaError, read_coordination_json,
+    )
+
+    path = tmp_path / "host-001.json"
+    path.write_text('{"schema": 0, "status": "running"}')
+    with pytest.raises(CoordinationSchemaError, match="schema 0"):
+        read_coordination_json(path)
+    path.write_text('{"status": "running"}')  # pre-versioning build
+    with pytest.raises(CoordinationSchemaError, match="schema None"):
+        read_coordination_json(path)
+    # a non-object document is noise, not a protocol statement
+    path.write_text('[1, 2, 3]')
+    assert read_coordination_json(path) is None
+
+
+def test_supervisor_sidecar_schema_roundtrip(tmp_path):
+    """write_supervisor_state stamps the schema; peek reads it back, and
+    rejects (as None, loudly logged) a sidecar from an older build."""
+    from ml_recipe_tpu.resilience.coordination import COORD_SCHEMA_VERSION
+    from ml_recipe_tpu.resilience.supervisor import (
+        peek_supervisor_state, write_supervisor_state,
+    )
+
+    path = tmp_path / "supervisor_state.json"
+    write_supervisor_state(path, {"status": "running", "attempts": 1})
+    doc = peek_supervisor_state(path)
+    assert doc["status"] == "running"
+    assert doc["schema"] == COORD_SCHEMA_VERSION
+    path.write_text('{"status": "running"}')  # schema-less old sidecar
+    assert peek_supervisor_state(path) is None
+
+
+def test_pod_coordinator_publish_and_peer_views(tmp_path):
+    """Two coordinators on one directory see each other's documents; the
+    child-side heartbeat (watchdog-wired in production) surfaces through
+    child_step."""
+    from ml_recipe_tpu.resilience.coordination import (
+        PodCoordinator, write_child_heartbeat,
+    )
+
+    coord_dir = tmp_path / "pod"
+    a = PodCoordinator(coord_dir, host=0, n_hosts=2)
+    b = PodCoordinator(coord_dir, host=1, n_hosts=2)
+    a.publish("running", generation=2, attempt=1, live_hosts=[0, 1])
+    b.publish("restarting", generation=3, attempt=4)
+
+    seen_by_b = b.peer_states()
+    assert set(seen_by_b) == {0}
+    assert seen_by_b[0]["status"] == "running"
+    assert seen_by_b[0]["generation"] == 2
+    assert seen_by_b[0]["live_hosts"] == [0, 1]
+    assert a.peer_state(1)["status"] == "restarting"
+
+    assert a.child_step(1) is None  # no child ever beat
+    write_child_heartbeat(coord_dir, 1, step=17)
+    assert a.child_step(1) == 17
+
+
+# ---------------------------------------------------------------------------
+# Host-scoped fault specs (%hostN): multi-host chaos determinism
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_host_scope_grammar():
+    plan = FaultPlan.parse("trainer.step:kill@4%host1; loader.read:raise@2")
+    assert [(s.site, s.kind, s.hit, s.host) for s in plan.specs] == [
+        ("trainer.step", "kill", 4, 1),
+        ("loader.read", "raise", 2, None),
+    ]
+    # scope composes with the rest of the grammar
+    spec = FaultPlan.parse("loader.read:raise@1x3!once%host0").specs[0]
+    assert (spec.count, spec.once, spec.host) == (3, True, 0)
+
+
+@pytest.mark.parametrize(
+    "bad",
+    ["trainer.step:kill%h1", "trainer.step:kill%host",
+     "loader.read:raise%pod1"],
+)
+def test_fault_plan_rejects_malformed_host_scope(bad):
+    with pytest.raises(ValueError, match="host scope|malformed"):
+        FaultPlan.parse(bad)
+
+
+def test_fault_host_scope_gates_action_not_counter(monkeypatch):
+    """The ARRIVAL counter advances on every host (the nth step is the
+    nth step everywhere); only the ACTION is scoped — that is what makes
+    'kill host 1 at step 4' mean the same step on every host."""
+    from ml_recipe_tpu.resilience.faults import HOST_ENV
+
+    monkeypatch.setenv(HOST_ENV, "0")
+    plan = FaultPlan.parse("loader.read:raise@1%host1")
+    plan.fire("loader.read")  # scoped to host 1: no action on host 0
+    assert plan.hits("loader.read") == 1
+
+    monkeypatch.setenv(HOST_ENV, "1")
+    plan2 = FaultPlan.parse("loader.read:raise@1%host1")
+    with pytest.raises(FaultError):
+        plan2.fire("loader.read")
+
+
+def test_fault_once_markers_are_per_host(tmp_path, monkeypatch):
+    """!once state is keyed per host: a SHARED state dir (the normal
+    multi-host layout) must never let host 0's firing suppress host 1's."""
+    from ml_recipe_tpu.resilience.faults import HOST_ENV
+
+    state = str(tmp_path / "fault-state")
+    spec = "loader.read:raise@1!once"
+
+    monkeypatch.setenv(HOST_ENV, "0")
+    with pytest.raises(FaultError):
+        FaultPlan.parse(spec, state_dir=state).fire("loader.read")
+    # host 0 restarted: suppressed by its own marker
+    FaultPlan.parse(spec, state_dir=state).fire("loader.read")
+
+    monkeypatch.setenv(HOST_ENV, "1")  # host 1, same state dir: still fires
+    with pytest.raises(FaultError):
+        FaultPlan.parse(spec, state_dir=state).fire("loader.read")
+
+
+def test_current_host_defaults_and_ignores_garbage(monkeypatch):
+    from ml_recipe_tpu.resilience.faults import HOST_ENV, current_host
+
+    monkeypatch.delenv(HOST_ENV, raising=False)
+    assert current_host() == 0
+    monkeypatch.setenv(HOST_ENV, "3")
+    assert current_host() == 3
+    monkeypatch.setenv(HOST_ENV, "not-a-host")
+    assert current_host() == 0
+
+
+# ---------------------------------------------------------------------------
+# ElasticSupervisor unit: scripted children + hand-written peer documents
+# ---------------------------------------------------------------------------
+
+
+def _write_peer(coord_dir, host, *, status="running", generation=0,
+                age=0.0, step=None):
+    """A peer host's coordination document, optionally back-dated by
+    ``age`` seconds (the staleness signal)."""
+    from ml_recipe_tpu.metrics.artifacts import atomic_write_json, wall_now
+    from ml_recipe_tpu.resilience.coordination import COORD_SCHEMA_VERSION
+
+    atomic_write_json(
+        os.path.join(str(coord_dir), f"host-{host:03d}.json"),
+        {
+            "schema": COORD_SCHEMA_VERSION, "host": host, "pid": 0,
+            "status": status, "generation": generation, "attempt": 0,
+            "step": step, "exit_class": None, "live_hosts": None,
+            "heartbeat": wall_now() - age,
+        },
+    )
+
+
+def _elastic_supervisor(tmp_path, children, steps, *, host=0, n_hosts=2,
+                        min_world=1, host_timeout=60.0, ledger=False,
+                        flight=False):
+    from ml_recipe_tpu.resilience.coordination import PodCoordinator
+    from ml_recipe_tpu.resilience.supervisor import ElasticSupervisor
+
+    coord = PodCoordinator(tmp_path / "pod", host=host, n_hosts=n_hosts)
+    child_iter = iter(children)
+    step_iter = iter(steps)
+    return ElasticSupervisor(
+        lambda i: next(child_iter),
+        coordinator=coord,
+        host_timeout=host_timeout,
+        poll_interval=0.01,
+        min_world=min_world,
+        progress=lambda: next(step_iter),
+        # max_restarts=0: ANY budget-charged restart would end the loop,
+        # so a run that continues past a coordinated outcome proves the
+        # exemption
+        policy=RetryPolicy(max_restarts=0, crash_loop_window=10),
+        sleep=lambda s: None,
+        ledger_path=str(tmp_path / "goodput.jsonl") if ledger else None,
+        flight_dir=str(tmp_path) if flight else None,
+    )
+
+
+def test_elastic_peer_generation_bump_is_pod_restart(tmp_path):
+    """A peer at a higher generation means the pod is restarting: the
+    outcome is pod-restart (budget-exempt, streak-exempt) and the
+    generation is adopted."""
+    from ml_recipe_tpu.resilience.coordination import read_coordination_json
+
+    _write_peer(tmp_path / "pod", 1, generation=3)
+    sup = _elastic_supervisor(tmp_path, [1, 0], [None, None, None, 1])
+    res = sup.run()
+    assert res.status == "clean"
+    # rc 1 would classify as 'crash'; the coordination sweep overrides it
+    assert res.outcomes() == ["pod-restart", "clean"]
+    assert res.exit_code == 0
+    assert sup.generation == 3
+    # no host was lost: the peer is restarting, not dead
+    assert sup.live_hosts() == [0, 1]
+    own = read_coordination_json(tmp_path / "pod" / "host-000.json")
+    assert own["status"] == "done" and own["generation"] == 3
+
+
+def test_elastic_stale_heartbeat_is_host_lost(tmp_path):
+    """A silently stale peer heartbeat is a DEAD HOST: the world shrinks,
+    the generation bumps, and the ledger/flight record name the cause."""
+    from ml_recipe_tpu.metrics.flightrec import newest_flight_record
+    from ml_recipe_tpu.metrics.goodput import read_ledger, summarize_events
+
+    from ml_recipe_tpu.resilience.coordination import write_child_heartbeat
+
+    _write_peer(tmp_path / "pod", 1, age=120.0, step=41)
+    write_child_heartbeat(tmp_path / "pod", 1, step=41)
+    sup = _elastic_supervisor(
+        tmp_path, [1, 0], [None, None, None, 7],
+        host_timeout=5.0, ledger=True, flight=True,
+    )
+    res = sup.run()
+    assert res.status == "clean"
+    assert res.outcomes() == ["host-lost", "clean"]
+    assert sup.live_hosts() == [0]
+    assert sup.generation == 1
+    assert "host death" in sup._lost_why[1]
+    assert sup.world == {"hosts": [0], "size": 1, "rank": 0, "generation": 1}
+
+    events = read_ledger(tmp_path / "goodput.jsonl")
+    lost = [e for e in events if e.get("ev") == "host_lost"]
+    assert len(lost) == 1
+    assert lost[0]["lost"] == 1 and lost[0]["last_step"] == 41
+    assert summarize_events(events)["hosts_lost"] == 1
+
+    _, doc = newest_flight_record(tmp_path)
+    assert "host_lost" in [e["kind"] for e in doc["events"]]
+
+
+def test_elastic_peer_failed_status_is_classified_crash_loop(tmp_path):
+    """A peer that PUBLISHED 'failed' (its own supervisor aborted) is a
+    classified crash-loop, not a silent host death — the world shrinks
+    without waiting out the staleness window."""
+    _write_peer(tmp_path / "pod", 1, status="failed")
+    sup = _elastic_supervisor(tmp_path, [1, 0], [None, None, None, 2])
+    res = sup.run()
+    assert res.outcomes() == ["host-lost", "clean"]
+    assert "crash-loop" in sup._lost_why[1]
+    assert "host death" not in sup._lost_why[1]
+
+
+def test_elastic_min_world_floor_aborts(tmp_path):
+    """Below --min_world the supervisor aborts with a diagnosis instead of
+    training degenerately narrow, and publishes 'failed' so peers (if any
+    were left) classify it."""
+    from ml_recipe_tpu.resilience.coordination import read_coordination_json
+
+    _write_peer(tmp_path / "pod", 1, age=120.0)
+    sup = _elastic_supervisor(
+        tmp_path, [1], [None, None], host_timeout=5.0, min_world=2,
+    )
+    res = sup.run()
+    assert res.status == "world-floor"
+    assert res.exit_code == 2
+    assert res.outcomes() == ["host-lost"]
+    assert "--min_world floor of 2" in res.diagnosis
+    assert "host 1" in res.diagnosis
+    own = read_coordination_json(tmp_path / "pod" / "host-000.json")
+    assert own["status"] == "failed"
+
+
+def test_elastic_losing_host0_aborts_when_peers_remain(tmp_path):
+    """Host 0 carries the rendezvous coordinator address: losing it with
+    >1 survivors cannot re-form a pod — abort with the reason, don't hang
+    in a rendezvous that can never complete."""
+    _write_peer(tmp_path / "pod", 0, age=120.0)
+    _write_peer(tmp_path / "pod", 2)  # healthy third host
+    sup = _elastic_supervisor(
+        tmp_path, [1], [None, None], host=1, n_hosts=3, host_timeout=5.0,
+    )
+    res = sup.run()
+    assert res.status == "coordinator-lost"
+    assert res.outcomes() == ["host-lost"]
+    assert "host 0" in res.diagnosis and "rendezvous" in res.diagnosis
+
+
+def test_elastic_sole_survivor_continues_without_host0(tmp_path):
+    """A SINGLE survivor needs no rendezvous: losing host 0 when you are
+    the only host left means continuing solo, not aborting."""
+    _write_peer(tmp_path / "pod", 0, age=120.0)
+    sup = _elastic_supervisor(
+        tmp_path, [1, 0], [None, None, None, 5], host=1, n_hosts=2,
+        host_timeout=5.0,
+    )
+    res = sup.run()
+    assert res.status == "clean"
+    assert res.outcomes() == ["host-lost", "clean"]
+    assert sup.world["size"] == 1 and sup.world["rank"] == 0
+
+
+def test_elastic_done_peer_is_not_polled_or_lost(tmp_path):
+    """A peer that finished cleanly leaves the poll set: its (aging)
+    document must never be misread as a dead host."""
+    _write_peer(tmp_path / "pod", 1, status="done")
+    sup = _elastic_supervisor(tmp_path, [0], [None, 3], host_timeout=5.0)
+    res = sup.run()
+    assert res.status == "clean"
+    assert res.outcomes() == ["clean"]
+    assert sup._done_hosts == {1}
+    assert sup.live_hosts() == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# Shrunk-mesh ParallelPlan re-derivation (elastic resume)
+# ---------------------------------------------------------------------------
+
+
+def _live_devices(n):
+    import jax
+
+    return jax.devices()[:n]
+
+
+def test_elastic_plan_shrinks_data_axis():
+    from ml_recipe_tpu.parallel.plan import ParallelPlan
+
+    plan = ParallelPlan.elastic_from_spec("data:8", devices=_live_devices(4))
+    assert plan.describe() == {"data": 4}
+    assert plan.shrunk
+    assert plan.requested_axes == {"data": 8}
+
+
+def test_elastic_plan_that_fits_is_not_shrunk():
+    from ml_recipe_tpu.parallel.plan import ParallelPlan
+
+    plan = ParallelPlan.elastic_from_spec("data:4", devices=_live_devices(4))
+    assert plan.describe() == {"data": 4}
+    assert not plan.shrunk
+    # fixed-world plans never report shrunk (requested_axes unset)
+    assert not ParallelPlan.from_spec("data:4", devices=_live_devices(4)).shrunk
+
+
+def test_elastic_plan_preserves_structural_axes():
+    """Only the data axis narrows: a pipe-bearing request over half the
+    devices keeps its pipeline depth and halves data parallelism."""
+    from ml_recipe_tpu.parallel.plan import ParallelPlan
+
+    plan = ParallelPlan.elastic_from_spec(
+        "data:4,pipe:2", devices=_live_devices(4)
+    )
+    assert plan.describe() == {"pipe": 2, "data": 2}
+    assert plan.shrunk
+    assert plan.requested_axes == {"pipe": 2, "data": 4}
+
+
+def test_elastic_plan_refuses_structural_shrink():
+    """pipe/seq/model change what each device OWNS — an elastic restart
+    must refuse loudly, never silently train a different model shape."""
+    from ml_recipe_tpu.parallel.mesh import ElasticMeshError
+    from ml_recipe_tpu.parallel.plan import ParallelPlan
+
+    with pytest.raises(ElasticMeshError, match="Only the data axis"):
+        ParallelPlan.elastic_from_spec(
+            "data:2,pipe:8", devices=_live_devices(4)
+        )
+
+
+def test_elastic_plan_enforces_min_data_floor():
+    from ml_recipe_tpu.parallel.mesh import ElasticMeshError
+    from ml_recipe_tpu.parallel.plan import ParallelPlan
+
+    with pytest.raises(ElasticMeshError, match="min_world"):
+        ParallelPlan.elastic_from_spec(
+            "data:8", devices=_live_devices(2), min_data=4
+        )
+
+
+def test_elastic_plan_zero1_repads_on_shrunk_mesh():
+    """The ZeRO-1 planner re-derives padding from the LIVE data-axis size:
+    a leaf padded to 24 under data:8 re-pads to 20 under the shrunk
+    data:4 — stale padding would corrupt the crop/zero-fill restore."""
+    from ml_recipe_tpu.parallel.plan import ParallelPlan
+
+    tree = {"mu": np.zeros(18, np.float32)}
+    full = ParallelPlan.from_spec("data:8", devices=_live_devices(8))
+    shrunk = ParallelPlan.elastic_from_spec("data:8", devices=_live_devices(4))
+    zfull = full.zero1(tree, min_size=0)
+    zshrunk = shrunk.zero1(tree, min_size=0)
+    assert zfull["mu"].padded == 24    # ceil(18/8) * 8
+    assert zshrunk["mu"].padded == 20  # ceil(18/4) * 4: re-derived
+    assert zshrunk["mu"].axis == 0
+
+
+# ---------------------------------------------------------------------------
+# End-to-end elastic chaos: host death mid-collective, shrunk-mesh resume
+# ---------------------------------------------------------------------------
+
+# Two "hosts" (2 devices each, 4 global). Per step each child: fires the
+# fault site, does work, beats its child heartbeat, then meets the others
+# at a FILE barrier with a deliberately long timeout — the stand-in for a
+# collective that never returns once a participant dies. Host 0 (rank 0)
+# appends goodput windows and saves a sharded checkpoint after each
+# barrier. The mesh comes from ParallelPlan.elastic_from_spec over the
+# devices of the CURRENT world (MLRT_ELASTIC_WORLD), so a shrunk relaunch
+# re-derives data:4 -> data:2.
+_ELASTIC_CHILD = textwrap.dedent(
+    """
+    import json, os, pathlib, sys, time
+    import numpy as np
+
+    size, rank = (int(x) for x in os.environ["MLRT_ELASTIC_WORLD"].split(":"))
+    host = int(os.environ["MLRT_HOST"])
+
+    from ml_recipe_tpu.parallel.plan import ParallelPlan
+    from ml_recipe_tpu.resilience import faults
+    from ml_recipe_tpu.resilience.coordination import write_child_heartbeat
+    from ml_recipe_tpu.metrics.flightrec import FlightRecorder
+    from ml_recipe_tpu.metrics.goodput import append_event
+    from ml_recipe_tpu.train.checkpoint import (
+        load_state_dict, peek_global_step, save_state_dict_sharded,
+    )
+
+    exp = pathlib.Path(sys.argv[1])
+    n_steps = int(sys.argv[2])
+    barrier_timeout = float(sys.argv[3])
+    ckpt = str(exp / "last.ch")
+    ledger = str(exp / "goodput.jsonl")
+    coord_dir = exp / "pod"
+    barrier_dir = exp / "barrier"
+    barrier_dir.mkdir(exist_ok=True)
+
+    plan = ParallelPlan.elastic_from_spec("data:4")
+    (exp / f"plan-w{size}-h{host}.json").write_text(json.dumps({
+        "axes": plan.describe(), "shrunk": plan.shrunk,
+        "requested": plan.requested_axes,
+    }))
+    if plan.shrunk and rank == 0:
+        rec = FlightRecorder.open_in(str(exp), process_index=10 + host)
+        rec.record("mesh_shrunk", old=plan.requested_axes,
+                   new=plan.describe())
+        rec.dump("elastic")
+
+    params = {"w": np.zeros(4, dtype=np.float32)}
+    start = 0
+    if peek_global_step(ckpt) is not None:
+        params, _, _, got = load_state_dict(ckpt, params=params)
+        start = got or 0
+    if rank == 0:
+        append_event(ledger, "run_start", step=start + 1)
+
+    def barrier(step):
+        # the "collective": every rank of the CURRENT world must arrive.
+        # A dead participant wedges everyone else until barrier_timeout
+        # (exit 99) — unless the supervisor kills us first, which is the
+        # entire point of cross-host supervision.
+        (barrier_dir / f"s{step}-w{size}-h{rank}.ok").write_text("ok")
+        deadline = time.monotonic() + barrier_timeout
+        for r in range(size):
+            want = barrier_dir / f"s{step}-w{size}-h{r}.ok"
+            while not want.exists():
+                if time.monotonic() > deadline:
+                    sys.stderr.write(f"BARRIER TIMEOUT at step {step}\\n")
+                    os._exit(99)
+                time.sleep(0.01)
+
+    for step in range(start + 1, n_steps + 1):
+        faults.fire("trainer.step")
+        t0 = time.time()
+        time.sleep(0.05)
+        params = {"w": params["w"] + 1.0}
+        write_child_heartbeat(coord_dir, host, step=step)
+        if rank == 0:
+            append_event(ledger, "steps", first_step=step, last_step=step,
+                         steps=1, productive_s=time.time() - t0)
+        barrier(step)
+        if rank == 0:
+            save_state_dict_sharded(ckpt, params=params, global_step=step)
+    print(f"DONE host={host} step={n_steps} w0={float(params['w'][0])}")
+    """
+)
+
+_BARRIER_TIMEOUT = 120.0  # the "collective timeout" survivors must beat
+
+
+def _elastic_child_env(size, rank, host):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MLRT_FAULTS"] = "trainer.step:kill@4%host1"
+    env["MLRT_HOST"] = str(host)
+    env["MLRT_ELASTIC_WORLD"] = f"{size}:{rank}"
+    # 2 devices per live host: the child's jax.devices() IS the live world
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={2 * size}"
+    )
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def test_chaos_host_death_shrinks_mesh_and_resumes(tmp_path):
+    """ISSUE-16 acceptance drill: trainer.step:kill@4%host1 kills host 1's
+    child at step 4; host 1's "machine" dies with it (its supervisor goes
+    silent). Host 0's child wedges at the step-4 collective; its elastic
+    supervisor must classify the silence as host death, kill the wedged
+    child WITHOUT waiting out the collective timeout, relaunch on the
+    shrunk world (data:4 -> data:2), resume from the step-3 checkpoint and
+    run to completion — with the goodput ledger partitioning the run
+    exactly and naming the lost host."""
+    import json as json_mod
+
+    from ml_recipe_tpu.metrics.flightrec import FLIGHTREC_PREFIX
+    from ml_recipe_tpu.metrics.goodput import read_ledger, summarize_events
+    from ml_recipe_tpu.resilience.coordination import PodCoordinator
+    from ml_recipe_tpu.resilience.supervisor import ElasticSupervisor
+    from ml_recipe_tpu.train.checkpoint import peek_global_step
+
+    exp = tmp_path / "exp"
+    exp.mkdir()
+    script = exp / "child.py"
+    script.write_text(_ELASTIC_CHILD)
+    ckpt = str(exp / "last.ch")
+    n_steps = 5
+
+    def spawn(size, rank, host, tag):
+        fh = open(exp / f"{tag}.log", "ab")
+        return subprocess.Popen(
+            [sys.executable, str(script), str(exp), str(n_steps),
+             str(_BARRIER_TIMEOUT)],
+            env=_elastic_child_env(size, rank, host),
+            cwd=REPO_ROOT, stdout=fh, stderr=fh,
+        )
+
+    # -- host 1: the doomed host. Its "supervisor" publishes heartbeats
+    # while its child lives; when the fault kills the child the whole host
+    # is gone — silence, no terminal publish, no restart.
+    doomed = {}
+
+    def run_doomed_host():
+        coord = PodCoordinator(exp / "pod", host=1, n_hosts=2)
+        coord.publish("running", generation=0, attempt=0)
+        child = spawn(2, 1, 1, "host1")
+        while child.poll() is None:
+            coord.publish("running", generation=0, attempt=0,
+                          step=coord.child_step(1))
+            time.sleep(0.1)
+        doomed["rc"] = child.returncode
+
+    host1 = threading.Thread(target=run_doomed_host)
+    host1.start()
+
+    # -- host 0: the real ElasticSupervisor (as _supervise_elastic wires
+    # it, with drill-speed timeouts)
+    sup_holder = []
+
+    def launch(attempt_i):
+        world = sup_holder[0].world
+        return spawn(world["size"], world["rank"], 0, f"host0-a{attempt_i}")
+
+    sup = ElasticSupervisor(
+        launch,
+        coordinator=PodCoordinator(exp / "pod", host=0, n_hosts=2),
+        host_timeout=2.0,
+        poll_interval=0.25,
+        min_world=1,
+        kill_grace=5.0,
+        progress=lambda: peek_global_step(ckpt, retries=2),
+        policy=_FAST_POLICY,
+        attempt_timeout=240,
+        state_path=str(exp / "supervisor_state.json"),
+        ledger_path=str(exp / "goodput.jsonl"),
+        flight_dir=str(exp),
+    )
+    sup_holder.append(sup)
+    t0 = time.monotonic()
+    result = sup.run()
+    elapsed = time.monotonic() - t0
+    host1.join(timeout=30)
+    assert not host1.is_alive()
+
+    # host 1 died to the injected kill, scoped to it alone
+    assert doomed["rc"] == KILL_EXIT_CODE
+
+    # the survivor restarted WITHOUT waiting out the collective timeout:
+    # its wedged child was killed by the supervisor (signal), not by the
+    # barrier deadline (exit 99)
+    assert result.status == "clean", result.diagnosis
+    assert result.outcomes() == ["host-lost", "clean"]
+    assert result.attempts[0].returncode != 99
+    assert result.attempts[0].returncode < 0  # killed by signal
+    assert elapsed < _BARRIER_TIMEOUT / 2
+    assert "host death" in sup._lost_why[1]
+
+    # shrunk-mesh resume: gen-1 ran the requested data:4; the relaunch
+    # re-derived data:2 over the surviving world and resumed from step 3
+    full = json_mod.loads((exp / "plan-w2-h0.json").read_text())
+    assert full == {"axes": {"data": 4}, "shrunk": False,
+                    "requested": {"data": 4}}
+    shrunk = json_mod.loads((exp / "plan-w1-h0.json").read_text())
+    assert shrunk == {"axes": {"data": 2}, "shrunk": True,
+                      "requested": {"data": 4}}
+    assert result.attempts[0].step_after == 3   # step-4 save never landed
+    assert result.attempts[1].step_before == 3
+    assert peek_global_step(ckpt) == n_steps
+    assert f"DONE host=0 step={n_steps} w0={float(n_steps)}" in (
+        (exp / "host0-a1.log").read_text(errors="replace")
+    )
+
+    # goodput ledger: exact partition, restart downtime and the recomputed
+    # step 4 both visible, the lost host counted
+    events = read_ledger(exp / "goodput.jsonl")
+    s = summarize_events(events)
+    assert s["attempts"] == 2
+    assert s["hosts_lost"] == 1
+    assert s["badput_s"]["restart_downtime"] > 0
+    assert s["badput_s"]["recompute"] > 0
+    assert s["recomputed_steps"] == 1  # step 4 ran, was lost, ran again
+    accounted = s["productive_s"] + sum(s["badput_s"].values())
+    assert accounted == pytest.approx(s["total_wall_s"], rel=1e-9)
+
+    # flight recorder: the elastic transitions are on disk — host_lost
+    # from the supervisor, mesh_shrunk from the shrunk child
+    kinds = set()
+    for path in exp.glob(f"{FLIGHTREC_PREFIX}*.json"):
+        doc = json_mod.loads(path.read_text())
+        kinds.update(e["kind"] for e in doc.get("events", []))
+    assert "host_lost" in kinds
+    assert "mesh_shrunk" in kinds
