@@ -702,6 +702,7 @@ def bench_serve(args) -> None:
         write_learnable_vocab,
     )
     from ml_recipe_tpu.models import MODEL_PRESETS, QAModel
+    from ml_recipe_tpu.ops import aot
     from ml_recipe_tpu.parallel import build_mesh
     from ml_recipe_tpu.serve.bucketing import BucketGrid
     from ml_recipe_tpu.serve.engine import QAEngine
@@ -710,6 +711,16 @@ def bench_serve(args) -> None:
     n_chips = len(jax.devices())
     mesh = build_mesh()
     grid = BucketGrid.from_spec(args.serve_buckets)
+
+    # --aot_cold_warm_probe: point the program store at a FRESH directory
+    # so the first engine's warmup is deterministically cold (compile +
+    # persist) and the replacement engine built after the timed loop is
+    # the measured warm restart (deserialize only)
+    aot_probe_dir = None
+    if getattr(args, "aot_cold_warm_probe", False):
+        aot_probe_dir = tempfile.mkdtemp(prefix="bench_aot_probe_")
+        aot.reset()
+        aot.configure(enabled=True, cache_dir=aot_probe_dir)
 
     tmp = Path(tempfile.mkdtemp(prefix="bench_serve_"))
     try:
@@ -831,6 +842,35 @@ def bench_serve(args) -> None:
         elapsed = time.perf_counter() - t0
         engine.close()
 
+        # rolling-restart leg of --aot_cold_warm_probe: a replacement
+        # engine over the same model/grid warms up from the store the
+        # first engine populated — its warmup should compile ZERO bucket
+        # programs (misses == 0) and take a small fraction of the cold one
+        aot_probe = None
+        if getattr(args, "aot_cold_warm_probe", False):
+            engine2 = QAEngine(
+                model, params, tokenizer, grid=BucketGrid.from_spec(
+                    args.serve_buckets),
+                mesh=mesh,
+                max_batch_delay_ms=args.max_batch_delay_ms,
+                queue_size=args.serve_queue_size,
+                max_question_len=16, doc_stride=args.doc_stride,
+                quantize=quantize,
+            )
+            warm2 = engine2.warmup(hbm_preflight=args.hbm_preflight)
+            engine2.close()
+            cold_s = warm["warmup_seconds"]
+            warm_s = warm2["warmup_seconds"]
+            aot_probe = {
+                "cold_compile_s": cold_s,
+                "warm_load_s": warm_s,
+                "speedup_x": (
+                    round(cold_s / warm_s, 1) if warm_s else None),
+                "hits": int(engine2.m_aot_hits.value),
+                "misses": int(engine2.m_aot_misses.value),
+            }
+            shutil.rmtree(aot_probe_dir, ignore_errors=True)
+
         lat_ms = np.sort(np.asarray([d for d, _ in latencies])) * 1e3
         hot_ms = np.sort(np.asarray(
             [d for d, is_hot in latencies if is_hot])) * 1e3
@@ -884,6 +924,12 @@ def bench_serve(args) -> None:
                     "max_batch_delay_ms": args.max_batch_delay_ms,
                     "warmup_seconds": warm["warmup_seconds"],
                     "autotune_probes": warm["autotune"]["probes"],
+                    # AOT program-store provenance of the benched engine's
+                    # warmup + the optional rolling-restart measurement
+                    "aot_cache": warm["aot"]["cache"],
+                    "aot_hits": warm["aot"]["hits"],
+                    "aot_misses": warm["aot"]["misses"],
+                    "cold_vs_warm_compile_s": aot_probe,
                     "n_chips": n_chips,
                     "backend": jax.default_backend(),
                 }
@@ -1216,6 +1262,20 @@ def main() -> None:
     parser.add_argument("--autotune_cache", type=str, default=None,
                         help="Tuning-cache directory (default "
                              "artifacts/tuning/ or $MLRT_AUTOTUNE_CACHE).")
+    parser.add_argument("--aot_cache", type=str, default=None,
+                        help="AOT compiled-program store (ops/aot.py): "
+                             "'off' disables it, a path overrides the "
+                             "store directory (default artifacts/aot/ or "
+                             "$MLRT_AOT_CACHE). The train/serve JSON lines "
+                             "carry aot_cache/aot_hits/aot_misses either "
+                             "way.")
+    parser.add_argument("--aot_cold_warm_probe", action="store_true",
+                        help="train/serve modes: measure the store's win "
+                             "directly — build the same program twice "
+                             "against a fresh store directory (first build "
+                             "cold-compiles and persists, second "
+                             "deserializes) and emit both timings as "
+                             "cold_vs_warm_compile_s.")
     parser.add_argument("--hbm_preflight", type=_str2bool, default=True,
                         help="Raise batch_split from compiled "
                              "memory_analysis instead of OOMing in XLA.")
@@ -1300,9 +1360,14 @@ def main() -> None:
     except RuntimeError as e:
         return _emit_backend_failure(e)
 
-    from ml_recipe_tpu.ops import autotune
+    from ml_recipe_tpu.ops import aot, autotune
 
     autotune.configure(enabled=args.autotune, cache_dir=args.autotune_cache)
+    aot.configure(
+        enabled=args.aot_cache != "off",
+        cache_dir=(
+            args.aot_cache if args.aot_cache not in (None, "off") else None),
+    )
 
     if args.mode == "infer":
         return bench_infer(args)
@@ -1506,6 +1571,39 @@ def main() -> None:
             goodput.note_checkpoint("save", ckpt_total_s - ckpt_blocking_s)
         goodput.note_run_end(step_i)
 
+        # --aot_cold_warm_probe: the program store's win measured directly.
+        # Build the SAME train-step program twice against a fresh store
+        # directory: the first build cold-compiles and persists, the second
+        # — dispatch memo cleared, exactly a restarted process's state —
+        # deserializes. Runs AFTER note_run_end so neither build pollutes
+        # the goodput partition of the benched configuration; the session
+        # summary for the JSON line is snapshotted first for the same
+        # reason.
+        aot_summary = aot.get().session_summary()
+        aot_probe = None
+        if getattr(args, "aot_cold_warm_probe", False):
+            probe_dir = tempfile.mkdtemp(prefix="bench_aot_probe_")
+            aot.reset()
+            aot.configure(enabled=True, cache_dir=probe_dir)
+            trainer._compiled_steps.clear()
+            t0 = time.perf_counter()
+            trainer._aot_train_step_program(inputs, labels)
+            cold_s = time.perf_counter() - t0
+            trainer._compiled_steps.clear()
+            t0 = time.perf_counter()
+            trainer._aot_train_step_program(inputs, labels)
+            warm_s = time.perf_counter() - t0
+            probe_store = aot.get()
+            aot_probe = {
+                "cold_compile_s": round(cold_s, 4),
+                "warm_load_s": round(warm_s, 4),
+                "speedup_x": (
+                    round(cold_s / warm_s, 1) if warm_s > 0 else None),
+                "hits": probe_store.hits,
+                "misses": probe_store.misses,
+            }
+            shutil.rmtree(probe_dir, ignore_errors=True)
+
         # pipe-bubble sweep (--pipe_sweep_microbatches, validated above):
         # re-time the step at the same global batch with varying micro-
         # batch counts; under the GPipe model T(m) = ideal * (m+K-1)/m,
@@ -1660,6 +1758,12 @@ def main() -> None:
                 "autotune_cache": tuning["cache"],
                 "autotune_probes": tuning["probes"],
                 "autotune_geometry": tuning["decisions"],
+                # AOT program-store provenance: 'hit' = every program this
+                # run needed was deserialized (zero XLA compiles)
+                "aot_cache": aot_summary["cache"],
+                "aot_hits": aot_summary["hits"],
+                "aot_misses": aot_summary["misses"],
+                "cold_vs_warm_compile_s": aot_probe,
                 "ln_impl": args.ln_impl,
                 "n_chips": n_chips,
                 "backend": jax.default_backend(),

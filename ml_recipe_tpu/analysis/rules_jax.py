@@ -452,3 +452,69 @@ def check_hand_rolled_sharding(ctx: Context) -> Iterable[Finding]:
                     f"put_replicated), or allowlist a genuine low-level "
                     f"site with a reason",
                 )
+
+
+# -- MLA011 unrouted-aot-compile ---------------------------------------------
+
+# the two modules that ARE the program-build plane: the AOT store itself
+# and the autotuner whose probe sweeps it serves
+_MLA011_EXEMPT = (
+    "ml_recipe_tpu/ops/aot.py",
+    "ml_recipe_tpu/ops/autotune.py",
+)
+
+
+def _mla011_in_scope(path: str) -> bool:
+    return path.startswith("ml_recipe_tpu/") and path not in _MLA011_EXEMPT
+
+
+@register(
+    "MLA011", "unrouted-aot-compile", "error",
+    summary=(
+        "a `.lower(...).compile(...)` chain outside ops/aot.py — every "
+        "program build must route through the AOT compiled-program "
+        "store (aot.load_or_compile / aot.probe_compile) so warm "
+        "restarts deserialize it instead of recompiling"
+    ),
+    rationale=(
+        "ISSUE 17 made zero-compile warm restarts a fleet property: the "
+        "trainer step, HBM pre-flights, serving bucket grid and kernel "
+        "probe sweeps all build programs through ops/aot.py, which "
+        "persists the serialized executable keyed by device kind, mesh "
+        "plan, geometry and code fingerprint. A raw lower().compile() "
+        "chain is a program the store never sees — it recompiles on "
+        "every restart, silently eroding the cold-start win the store "
+        "exists to keep"
+    ),
+)
+def check_unrouted_aot_compile(ctx: Context) -> Iterable[Finding]:
+    from .engine import get_rule
+
+    rule = get_rule("MLA011")
+    for src in ctx.files:
+        if not _mla011_in_scope(src.path):
+            continue
+        for node in ast.walk(src.tree):
+            # Call(.compile) whose receiver is itself Call(.lower) — the
+            # chained spelling every jit AOT build in this package uses.
+            # A split `lowered = f.lower(...); lowered.compile()` would
+            # evade the pattern; tracking that binding is deliberately
+            # out (precision over recall — the suite's standing
+            # heuristic), the allowlist is the escape hatch either way.
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "compile"
+                and isinstance(node.func.value, ast.Call)
+                and isinstance(node.func.value.func, ast.Attribute)
+                and node.func.value.func.attr == "lower"
+            ):
+                continue
+            yield rule.finding(
+                src, node,
+                "`.lower(...).compile()` builds a program the AOT store "
+                "never sees — route it through aot.load_or_compile (step/"
+                "bucket programs) or aot.probe_compile (kernel probe "
+                "sweeps) so a warm restart deserializes it instead of "
+                "recompiling",
+            )

@@ -24,7 +24,7 @@ from pathlib import Path
 
 from ..compose import init_model
 from ..config.parser import get_model_parser, get_params, get_serve_parser
-from ..ops import autotune
+from ..ops import aot, autotune
 from ..parallel import ParallelPlan
 from ..utils.logging import get_logger, show_params
 
@@ -39,6 +39,16 @@ def main(params, model_params) -> int:
 
     autotune.configure(
         enabled=params.autotune, cache_dir=params.autotune_cache
+    )
+    # AOT program-store wiring (ops/aot.py): a rolling-restart replacement
+    # engine deserializes every bucket program from the shared store
+    # instead of recompiling the grid
+    aot.configure(
+        enabled=params.aot_cache != "off",
+        cache_dir=(
+            params.aot_cache if params.aot_cache not in (None, "off")
+            else None),
+        cache_bytes=params.aot_cache_bytes or None,
     )
 
     # --trace_spans: structured request-lifecycle spans (admission ->

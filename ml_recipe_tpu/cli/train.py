@@ -94,11 +94,20 @@ def _run_worker(params, model_params, watchdog) -> None:
 
     # Geometry autotuner wiring: --autotune / --autotune_cache drive the
     # process-wide selector the attention kernels consult (ops/autotune.py).
-    from ..ops import autotune
+    from ..ops import aot, autotune
 
     autotune.configure(
         enabled=getattr(params, "autotune", True),
         cache_dir=getattr(params, "autotune_cache", None),
+    )
+    # AOT program-store wiring: --aot_cache is 'off' | a directory | None
+    # (default directory). A warm restart deserializes its train-step
+    # programs from the store instead of recompiling them (ops/aot.py).
+    _aot_cache = getattr(params, "aot_cache", None)
+    aot.configure(
+        enabled=_aot_cache != "off",
+        cache_dir=_aot_cache if _aot_cache not in (None, "off") else None,
+        cache_bytes=getattr(params, "aot_cache_bytes", 0) or None,
     )
 
     # the declarative parallelism plan: built ONCE from --mesh; the
@@ -168,6 +177,8 @@ def _run_worker(params, model_params, watchdog) -> None:
 def _run_instrumented(params, model_params, watchdog, local_logger, plan,
                       data_rng, state) -> None:
     import jax
+
+    from ..ops import aot
 
     mesh = plan.mesh
     exp_dir = params.dump_dir / params.experiment_name
@@ -424,6 +435,9 @@ def _run_instrumented(params, model_params, watchdog, local_logger, plan,
         # that races the background persist would restart from stale state
         trainer.finish_pending_checkpoint()
         if goodput is not None:
+            _store = aot.get()
+            goodput.note_aot(
+                _store.hits, _store.misses, sum(_store.load_times_s))
             goodput.note_run_end(trainer.global_step)
             local_logger.warning(goodput.summary_message())
         # under a supervisor, a caught preemption is a reason to RESUME:
@@ -449,6 +463,12 @@ def _run_instrumented(params, model_params, watchdog, local_logger, plan,
         # while its final checkpoint is still (or failed) persisting
         trainer.finish_pending_checkpoint()
         if goodput is not None:
+            # this attempt's program-store tally: a zero-compile warm
+            # restart is visible in the ledger as an aot event with
+            # misses == 0 next to a load-time-only compile_warmup share
+            _store = aot.get()
+            goodput.note_aot(
+                _store.hits, _store.misses, sum(_store.load_times_s))
             goodput.note_run_end(trainer.global_step)
             local_logger.warning(goodput.summary_message())
         if flightrec is not None:

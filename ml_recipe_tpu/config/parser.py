@@ -571,6 +571,18 @@ def get_trainer_parser() -> ConfigArgumentParser:
     parser.add_argument("--autotune_cache", type=cast2(str), default=None,
                         help="Directory of the tuning cache (default "
                              "artifacts/tuning/, or $MLRT_AUTOTUNE_CACHE).")
+    parser.add_argument("--aot_cache", type=cast2(str), default=None,
+                        help="AOT compiled-program store (ops/aot.py): "
+                             "'off' disables it (every program compiles, "
+                             "exactly the pre-store behavior), a path "
+                             "overrides the store directory (default "
+                             "artifacts/aot/, or $MLRT_AOT_CACHE). A warm "
+                             "restart deserializes its train-step programs "
+                             "instead of recompiling them.")
+    parser.add_argument("--aot_cache_bytes", type=cast_bytes, default=0,
+                        help="Byte budget for the AOT program store "
+                             "(K/M/G suffixes); oldest artifacts are "
+                             "evicted past it. 0 = unbounded.")
     parser.add_argument("--hbm_preflight", type=_str2bool, default=True,
                         help="Before the first train step, compile once and "
                              "read XLA's memory_analysis; if the step "
@@ -892,6 +904,17 @@ def get_serve_parser() -> ConfigArgumentParser:
     parser.add_argument("--autotune_cache", type=cast2(str), default=None,
                         help="Tuning-cache directory (default "
                              "artifacts/tuning/, or $MLRT_AUTOTUNE_CACHE).")
+    parser.add_argument("--aot_cache", type=cast2(str), default=None,
+                        help="AOT compiled-program store (ops/aot.py): "
+                             "'off' disables it, a path overrides the "
+                             "store directory (default artifacts/aot/, or "
+                             "$MLRT_AOT_CACHE). A rolling-restart "
+                             "replacement engine deserializes every bucket "
+                             "program instead of recompiling the grid.")
+    parser.add_argument("--aot_cache_bytes", type=cast_bytes, default=0,
+                        help="Byte budget for the AOT program store "
+                             "(K/M/G suffixes); oldest artifacts are "
+                             "evicted past it. 0 = unbounded.")
     parser.add_argument("--hbm_preflight", type=_str2bool, default=True,
                         help="Per-bucket predict-step HBM pre-flight at "
                              "warmup: memory_analysis each bucket program "

@@ -57,6 +57,22 @@ def atomic_write_json(path, doc, *, indent: Optional[int] = None) -> str:
     return path
 
 
+def atomic_write_bytes(path, payload: bytes) -> str:
+    """Binary sibling of :func:`atomic_write_json` (tmp + ``os.replace``) —
+    the AOT compiled-program store writes multi-megabyte executable blobs
+    that a concurrently warming process may be reading: it must see the old
+    artifact or the new one, never a truncated blob."""
+    path = os.fspath(path)
+    directory = os.path.dirname(path)
+    if directory:
+        os.makedirs(directory, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "wb") as fh:
+        fh.write(payload)
+    os.replace(tmp, path)
+    return path
+
+
 def append_jsonl(path, record: dict) -> None:
     """Append one JSON record as a single line via one ``os.write`` on an
     ``O_APPEND`` descriptor — POSIX appends of one small buffer land whole,

@@ -98,6 +98,13 @@ def summarize_events(events: List[dict], *, now: Optional[float] = None) -> dict
         # partitioned into restart_downtime/recompute; this is the COUNT
         # the run report names the cause with)
         "hosts_lost": 0,
+        # AOT program-store outcomes (ops/aot.py): hits are programs that
+        # were DESERIALIZED instead of compiled — a warm restart shows
+        # aot_misses == 0 alongside a compile_warmup share that is pure
+        # load time. Counts only; their wall-clock already lives inside
+        # compile_warmup, so the partition stays exact.
+        "aot_hits": 0,
+        "aot_misses": 0,
         "events": len(events),
     }
     stamped = [e for e in events if isinstance(e.get("t"), (int, float))]
@@ -161,6 +168,9 @@ def summarize_events(events: List[dict], *, now: Optional[float] = None) -> dict
             badput["eval"] += float(e.get("seconds", 0.0))
         elif ev == "host_lost":
             summary["hosts_lost"] += 1
+        elif ev == "aot":
+            summary["aot_hits"] += int(e.get("hits", 0))
+            summary["aot_misses"] += int(e.get("misses", 0))
 
     total = max(0.0, t1 - t0)
     productive = sum(w["productive_s"] for w in windows)
@@ -234,10 +244,13 @@ class GoodputLedger:
             })
 
     def note_step(self, step: int, *, wall_s: float,
-                  data_wait_s: float = 0.0, compile: bool = False) -> None:
+                  data_wait_s: float = 0.0, compile: bool = False,
+                  aot_hit: Optional[bool] = None) -> None:
         """One consumed step's on-wall time. ``compile=True`` (the first
         observed step) books the whole non-wait share as compile/warmup
-        badput instead of productive time."""
+        badput instead of productive time; ``aot_hit`` (only meaningful
+        on that step) stamps whether the warmup was an AOT program-store
+        load rather than a real XLA compile."""
         with self._lock:
             wait = min(max(0.0, float(data_wait_s)), max(0.0, float(wall_s)))
             productive = max(0.0, float(wall_s) - wait)
@@ -253,6 +266,8 @@ class GoodputLedger:
             w["data_wait_s"] += wait
             if compile:
                 w["compile_s"] += productive
+                if aot_hit is not None:
+                    w["aot_hit"] = bool(aot_hit)
             else:
                 w["productive_s"] += productive
             if w["steps"] >= self.flush_every:
@@ -277,6 +292,17 @@ class GoodputLedger:
     def note_eval(self, seconds: float) -> None:
         with self._lock:
             self._emit({"ev": "eval", "seconds": float(seconds)})
+
+    def note_aot(self, hits: int, misses: int, load_s: float = 0.0) -> None:
+        """This attempt's AOT program-store tally (run end): how many XLA
+        compiles the store replaced with deserialization, and the load
+        time spent doing so. A zero-compile warm restart is the attempt
+        whose ``aot`` event shows ``misses == 0``."""
+        with self._lock:
+            self._emit({
+                "ev": "aot", "hits": int(hits), "misses": int(misses),
+                "load_s": float(load_s),
+            })
 
     def note_run_end(self, step: int) -> None:
         with self._lock:

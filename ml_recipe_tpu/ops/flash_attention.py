@@ -48,7 +48,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from . import autotune
+from . import aot, autotune
 
 _NEG_INF = -1e30
 
@@ -786,7 +786,11 @@ def _probe_compiles(call, arg_shapes, *, aggressive: bool):
       bug — re-raise rather than silently routing the shape off-kernel.
     """
     try:
-        return jax.jit(call).lower(*arg_shapes).compile()
+        # hlo-keyed AOT store routing: each candidate's compiled probe
+        # persists under its own program hash, so a warm restart (or a
+        # cleared tuning cache on an unchanged toolchain) loads the
+        # probes instead of re-paying Mosaic compiles
+        return aot.probe_compile("attn-probe", call, *arg_shapes)
     except Exception as e:  # noqa: BLE001 - classified below
         if _looks_like_vmem_overflow(e):
             return False

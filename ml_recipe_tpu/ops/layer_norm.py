@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from . import aot
 from .flash_attention import _VMEM_BUDGET
 
 
@@ -197,12 +198,17 @@ def _fused_ln_compiles(blk, C, in_dtype, out_dtype, gamma_dtype, beta_dtype,
         beta_s = jax.ShapeDtypeStruct((1, C), beta_dtype)
         g_s = jax.ShapeDtypeStruct((blk, C), out_dtype)
         try:
+            # validation compiles ride the AOT program store: the verdict
+            # memo above is per-process, but the compiled probes persist —
+            # a warm restart re-validates by LOADING, not re-compiling
             fwd = _build_ln_fwd_call(blk, C, blk, eps, in_dtype, out_dtype,
                                      interpret=False)
-            jax.jit(fwd).lower(h_s, gamma_s, beta_s).compile()
+            aot.probe_compile("ln-probe-fwd", fwd, h_s, gamma_s, beta_s,
+                              geometry=f"{blk}x{C}")
             bwd = _build_ln_bwd_call(blk, C, blk, eps, in_dtype,
                                      interpret=False)
-            jax.jit(bwd).lower(h_s, gamma_s, g_s).compile()
+            aot.probe_compile("ln-probe-bwd", bwd, h_s, gamma_s, g_s,
+                              geometry=f"{blk}x{C}")
             ok = True
         except Exception as e:  # noqa: BLE001 - any rejection means fallback
             logging.getLogger(__name__).warning(

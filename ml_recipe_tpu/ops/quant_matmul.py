@@ -43,7 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-from . import autotune, flash_attention
+from . import aot, autotune, flash_attention
 
 # symmetric int8 grid: +-127 (the -128 code is unused so negation is exact)
 INT8_MAX = 127.0
@@ -211,7 +211,13 @@ def _q8_geometry(M: int, K: int, N: int,
             jax.ShapeDtypeStruct((1, N), jnp.float32),
         ]
         try:
-            return jax.jit(call).lower(*args).compile()
+            # probe winners persist their compiled programs in the AOT
+            # store (hlo-keyed, so sibling candidates coexist): a warm
+            # restart loads instead of re-paying the Mosaic compile
+            return aot.probe_compile(
+                "q8-probe", call, *args,
+                geometry=f"{M}x{K}x{N}-bm{bm}-bn{bn}", extra="q8",
+            )
         except Exception as e:  # noqa: BLE001 - classified below
             if flash_attention._looks_like_vmem_overflow(e):
                 return False  # infeasible geometry, walk on

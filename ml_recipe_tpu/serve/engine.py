@@ -55,7 +55,7 @@ from ..data.chunking import (
     window_chunks,
 )
 from ..infer.score import OUT_KEYS, build_score_fn
-from ..ops import autotune
+from ..ops import aot, autotune
 from ..parallel import ParallelPlan, build_mesh, make_global_array
 # the HBM byte arithmetic is shared with Trainer.preflight_train_step — one
 # definition of "projected per-device bytes" for train and predict steps
@@ -240,6 +240,12 @@ class QAEngine:
         # seq) step-cost estimates are static once warmup records them, so
         # the batcher-thread hook memoizes autotune-cache lookups here
         self._flush_cost_memo: Dict[Tuple[int, int], Optional[float]] = {}
+        # AOT program-store dispatch plane (ops/aot.py): per-(batch, seq)
+        # bucket executables, populated during warmup (before the batcher
+        # thread starts) and read lock-free by _run_batch. Empty when the
+        # store is disabled — the hot path then falls back to self._jit,
+        # which is exactly the pre-store behavior.
+        self._compiled_programs: Dict[Tuple[int, int], object] = {}
 
         # ids-only wire when the vocab fits uint16 (predictor parity — see
         # infer/score.py for the two wire formats)
@@ -345,6 +351,20 @@ class QAEngine:
                 ("chunk", "Tier-2 chunk-result"),
             )
         }
+        # AOT program-store series, mirroring the trainer's
+        # train_aot_cache_* plane (registered unconditionally: the
+        # /metrics surface must not change shape with configuration)
+        self.m_aot_hits = m.counter(
+            "qa_aot_cache_hits_total",
+            "Bucket programs deserialized from the AOT program store "
+            "instead of compiled (ops/aot.py).")
+        self.m_aot_misses = m.counter(
+            "qa_aot_cache_misses_total",
+            "Bucket programs compiled and persisted to the AOT program "
+            "store for the next restart.")
+        self.m_aot_load = m.histogram(
+            "qa_aot_load_seconds",
+            "AOT bucket-program load (deserialize) times on store hits.")
         self.m_flight_joins = m.counter(
             "qa_chunk_flight_joins_total",
             "Chunks that piggybacked on an identical in-flight chunk "
@@ -444,7 +464,8 @@ class QAEngine:
         else:
             with self.mesh:
                 dev = self._wire_pack(self._dummy_inputs(bucket))
-                compiled = self._jit.lower(self.params, dev).compile()
+                compiled = self._aot_bucket_program(
+                    bucket.batch, bucket.seq, dev)
         # this compiled program is exactly what the flush-cost recorder
         # needs — record here so warmup doesn't pay a second AOT compile
         # (injected compile_fn fakes expose no cost_analysis: a no-op)
@@ -522,14 +543,30 @@ class QAEngine:
                 tuner = autotune.get()
                 est = tuner.lookup_cost(
                     self._program_cost_key(bucket.batch, bucket.seq))
+                program = (
+                    self._aot_bucket_program(bucket.batch, bucket.seq, dev)
+                    if aot.get().enabled else None
+                )
                 if tuner.enabled and est is None:
+                    # store disabled: load_or_compile degrades to the same
+                    # lower+compile this site always paid (bypass outcome)
                     est = self._record_program_cost(
-                        bucket, self._jit.lower(self.params, dev).compile())
-                np.asarray(self._jit(self.params, dev))
+                        bucket,
+                        program if program is not None
+                        else aot.get().load_or_compile(
+                            "serve-step", self._jit, self.params, dev,
+                            geometry=f"{bucket.batch}x{bucket.seq}",
+                            plan=aot.plan_signature(self.plan),
+                            extra=self._program_signature(),
+                        ))
+                np.asarray(
+                    program(self.params, dev) if program is not None
+                    else self._jit(self.params, dev))
             report.setdefault("program_costs", {})[str(bucket)] = (
                 est["est_seconds"] if est else None)
             report["buckets"].append(str(bucket))
         report["autotune"] = autotune.get().session_summary()
+        report["aot"] = aot.get().session_summary()
         report["warmup_seconds"] = round(time.perf_counter() - t0, 3)
         self.warmup_report = report
         self.batcher.start()
@@ -543,13 +580,14 @@ class QAEngine:
 
     # -- measured flush ranking (batcher thread) -------------------------------
 
-    def _program_cost_key(self, batch: int, seq: int) -> str:
-        """Tuning-cache key of one bucket's whole serving program. Carries
-        the model geometry (the tuning cache is shared per device kind —
-        bert-tiny's step cost must never rank a bert-large grid), the wire
-        format, and the active precision (the ``q8`` suffix discipline of
-        ops/quant_matmul.py): each is a different compiled program with a
-        different measured cost."""
+    def _program_signature(self) -> str:
+        """Shape-independent identity of the serving program: model
+        geometry (caches are shared per device kind — bert-tiny's
+        artifacts must never serve a bert-large grid), wire format, and
+        active precision (the ``q8`` suffix discipline of
+        ops/quant_matmul.py). Shared between the autotune cost keys and
+        the AOT program-store keys so both planes agree on what "the same
+        program" means."""
         cfg = getattr(self.model, "cfg", None)
         sig = (
             f"h{cfg.hidden_size}l{cfg.num_layers}n{cfg.num_heads}"
@@ -557,7 +595,39 @@ class QAEngine:
         )
         wire = "ids" if self._wire_ids_only else "3p"
         suffix = "-q8" if self.quantize == "int8" else ""
-        return f"serve-step-{batch}x{seq}-{sig}-{wire}{suffix}"
+        return f"{sig}-{wire}{suffix}"
+
+    def _program_cost_key(self, batch: int, seq: int) -> str:
+        """Tuning-cache key of one bucket's whole serving program: each
+        (shape, signature) pair is a different compiled program with a
+        different measured cost."""
+        return f"serve-step-{batch}x{seq}-{self._program_signature()}"
+
+    def _aot_bucket_program(self, batch: int, seq: int, dev):
+        """One bucket's executable through the AOT program store
+        (ops/aot.py): deserialized on a warm restart (zero XLA compiles),
+        compiled-and-persisted on a cold one. Memoized per (batch, seq)
+        for the batcher-thread hot path; bypass outcomes (store disabled)
+        are NOT memoized — the engine then dispatches through self._jit
+        exactly as before the store existed."""
+        key = (batch, seq)
+        program = self._compiled_programs.get(key)
+        if program is not None:
+            return program
+        program, outcome, seconds = aot.get().load_or_compile_ex(
+            "serve-step", self._jit, self.params, dev,
+            geometry=f"{batch}x{seq}",
+            plan=aot.plan_signature(self.plan),
+            extra=self._program_signature(),
+        )
+        if outcome != "bypass":
+            self._compiled_programs[key] = program
+            if outcome == "hit":
+                self.m_aot_hits.inc()
+                self.m_aot_load.observe(seconds)
+            else:
+                self.m_aot_misses.inc()
+        return program
 
     def _record_program_cost(self, bucket: Bucket, compiled) -> Optional[dict]:
         """Persist ``compiled``'s ``cost_analysis()`` estimate for this
@@ -860,7 +930,13 @@ class QAEngine:
         t_dev0 = time.perf_counter()
         with self.mesh:
             dev = self._wire_pack(inputs)
-            out = np.asarray(self._jit(self.params, dev))[:, :n]
+            # warmup populated the store-backed dispatch plane for every
+            # surviving bucket; an empty memo (store disabled) or an
+            # unwarmed shape falls back to the jit dispatch cache
+            program = self._compiled_programs.get((batch, seq))
+            out = np.asarray(
+                (program if program is not None else self._jit)(
+                    self.params, dev))[:, :n]
         if tracer is not None:
             tracer.complete(
                 "device", t_dev0, time.perf_counter(), cat="serve",

@@ -75,6 +75,8 @@ class TrainTelemetry:
         self.goodput = goodput
         self.flightrec = flightrec
         self._observed_steps = 0
+        self._aot_hits = 0
+        self._aot_misses = 0
         self.detector = SlowStepDetector(
             factor=anomaly_factor,
             window=anomaly_window,
@@ -143,6 +145,18 @@ class TrainTelemetry:
             "train_zero1_buckets",
             "Gradient buckets in the bucketed ZeRO-1 collective-overlap "
             "plan (0 = monolithic exchange / overlap off).")
+        self.m_aot_hits = m.counter(
+            "train_aot_cache_hits_total",
+            "AOT program-store loads that replaced an XLA compile "
+            "(ops/aot.py: zero-compile warm restarts).")
+        self.m_aot_misses = m.counter(
+            "train_aot_cache_misses_total",
+            "AOT program-store misses: programs compiled (and persisted "
+            "for the next restart).")
+        self.m_aot_load = m.histogram(
+            "train_aot_load_seconds",
+            "AOT program load (deserialize) times on store hits.",
+            STEP_BUCKETS)
         self.m_heartbeat_age = m.gauge(
             "train_watchdog_heartbeat_age_seconds",
             "Seconds since the step watchdog last saw progress "
@@ -233,12 +247,18 @@ class TrainTelemetry:
                 100.0 * (1.0 - real_tokens / total_tokens))
 
         # goodput ledger: the first observed step carries compilation —
-        # its non-wait share is compile/warmup badput, not productive time
+        # its non-wait share is compile/warmup badput, not productive time.
+        # The aot_hit flag says whether that warmup was a store LOAD
+        # (every observed program-store decision a hit) or a real compile
         first = self._observed_steps == 0
         self._observed_steps += 1
         if self.goodput is not None:
+            aot_hit = None
+            if first and (self._aot_hits or self._aot_misses):
+                aot_hit = self._aot_misses == 0
             self.goodput.note_step(
-                step, wall_s=total, data_wait_s=data_wait_s, compile=first
+                step, wall_s=total, data_wait_s=data_wait_s, compile=first,
+                aot_hit=aot_hit,
             )
 
         report = self.detector.update(step, total, breakdown)
@@ -271,6 +291,22 @@ class TrainTelemetry:
                     component_s=round(report.component_s, 6),
                 )
         return report
+
+    def observe_aot(self, outcome: str, seconds: float) -> None:
+        """One AOT program-store decision from the trainer's routing
+        (ops/aot.py): ``'hit'`` = deserialized (the load-time histogram
+        records it), ``'miss'`` = compiled. Bypass decisions (store off)
+        never reach here."""
+        if outcome == "hit":
+            self._aot_hits += 1
+            self.m_aot_hits.inc()
+            self.m_aot_load.observe(seconds)
+        elif outcome == "miss":
+            self._aot_misses += 1
+            self.m_aot_misses.inc()
+        if self.flightrec is not None:
+            self.flightrec.record(
+                "aot", outcome=outcome, seconds=round(seconds, 6))
 
     def observe_scalars(self, host_values: Dict[str, float]) -> None:
         """Per-consumed-step scalar taps from the train step's host fetch

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import hashlib
 import logging
 import os
 import time
@@ -57,6 +58,7 @@ from ..data.packing import (
 from ..losses import PackedWeightedLoss
 from ..metrics import AverageMeter
 from ..metrics import trace as trace_mod
+from ..ops import aot
 from ..metrics.trace import XplaneWindow
 from ..resilience.faults import fire as _fault
 from ..parallel import build_mesh, gather_to_host, make_global_array, shard_params
@@ -607,6 +609,15 @@ class Trainer:
         self._jit_eval_step = None
         self._preflight_done = not self.hbm_preflight
         self.preflight_report = None
+        # AOT program-store dispatch plane (ops/aot.py): placed-shape
+        # signature -> compiled executable. Filled by the pre-flight /
+        # first-step routing; run_step dispatches through it when the
+        # store is enabled, so a warm restart performs ZERO XLA compiles.
+        # Cleared whenever the jitted step is rebuilt (batch_split raise).
+        self._compiled_steps: dict = {}
+        # first train-step store outcome ('hit'/'miss') — the goodput
+        # ledger's compile_warmup window carries it as the aot_hit flag
+        self._aot_first_outcome = None
 
     def zero_enabled(self) -> bool:
         """True when the resolved layout is ``zero1`` AND the mesh has a
@@ -912,10 +923,10 @@ class Trainer:
                 labels = self._global_batch(
                     self._split_micro(host_labels), leading_accum=True
                 )
-                compiled = self._jit_train_step.lower(
-                    self.params, self.opt_state, inputs, labels,
-                    self.global_step,
-                ).compile()
+                # routed through the AOT program store: a warm restart's
+                # planning "compile" is a deserialization (loaded
+                # executables expose memory_analysis() too)
+                compiled = self._aot_train_step_program(inputs, labels)
             try:
                 analysis = compiled.memory_analysis()
             except Exception as e:  # noqa: BLE001 - analysis is best-effort
@@ -1022,16 +1033,16 @@ class Trainer:
                     compiled = compile_fn(self, seq, b)
                 else:
                     inputs, labels = synthetic_qa_batch(b, seq)
-                    compiled = self._jit_train_step.lower(
-                        self.params, self.opt_state,
+                    # AOT-store routed (see preflight_train_step): per-
+                    # bucket planning compiles deserialize on warm restart
+                    compiled = self._aot_train_step_program(
                         self._global_batch(
                             self._split_micro(inputs), leading_accum=True
                         ),
                         self._global_batch(
                             self._split_micro(labels), leading_accum=True
                         ),
-                        self.global_step,
-                    ).compile()
+                    )
                 try:
                     analysis = compiled.memory_analysis()
                 except Exception as e:  # noqa: BLE001 - analysis is best-effort
@@ -1081,7 +1092,91 @@ class Trainer:
 
     # -- compiled steps --------------------------------------------------------
 
+    def _step_signature(self, dev_inputs, dev_labels) -> str:
+        """Stable placed-shape key of one train-step program: every leaf's
+        shape+dtype (micro-split accumulation dim included, so a raised
+        batch_split keys differently)."""
+        parts = []
+        for tree in (dev_inputs, dev_labels):
+            for leaf in jax.tree_util.tree_leaves(tree):
+                parts.append(
+                    "x".join(str(d) for d in leaf.shape) + str(leaf.dtype)
+                )
+        return "_".join(parts)
+
+    def _sharding_signature(self, dev_inputs, dev_labels) -> str:
+        """Hash of every argument leaf's placement. AOT executables BAKE
+        IN input shardings: on a TP mesh the compiled step's outputs come
+        back resharded by its in-step constraints, so the program compiled
+        against the initial placement rejects step two's params — where a
+        jit wrapper would silently recompile, the dispatch plane must key
+        each sharding regime to its own executable (and a warm restart,
+        whose restored state already carries the steady-state placement,
+        hits the steady-state artifact directly)."""
+        specs = {}
+        parts = []
+        for tree in (self.params, self.opt_state, dev_inputs, dev_labels):
+            for leaf in jax.tree_util.tree_leaves(tree):
+                sharding = getattr(leaf, "sharding", None)
+                text = specs.get(id(sharding))
+                if text is None:
+                    text = str(getattr(sharding, "spec", sharding))
+                    specs[id(sharding)] = text
+                parts.append(text)
+        digest = hashlib.sha256("|".join(parts).encode()).hexdigest()
+        return digest[:12]
+
+    def _model_signature(self) -> str:
+        """Model-geometry key component (the serving engine's
+        ``_program_cost_key`` discipline: the store is shared per device
+        kind — bert-tiny's step must never load as bert-large's)."""
+        cfg = getattr(self.model, "cfg", None)
+        if cfg is None:
+            return "anon"
+        return (
+            f"h{cfg.hidden_size}l{cfg.num_layers}n{cfg.num_heads}"
+            f"v{cfg.vocab_size}"
+        )
+
+    def _aot_train_step_program(self, dev_inputs, dev_labels):
+        """The train-step executable for these PLACED batches, through the
+        AOT program store (ops/aot.py): loaded on a warm restart, compiled
+        (and persisted) cold — memoized per placed shape, so the HBM
+        pre-flight's program IS the first step's program. With the store
+        disabled this is exactly the ``lower().compile()`` HEAD performed
+        (and ``run_step`` keeps dispatching through the jit wrapper)."""
+        if not hasattr(self._jit_train_step, "lower"):
+            # the step fn was swapped for a plain wrapper (debug
+            # instrumentation, test recording seams): nothing to lower,
+            # dispatch it directly — the pre-store behavior
+            return self._jit_train_step
+        sig = (
+            f"{self._step_signature(dev_inputs, dev_labels)}"
+            f"-s{self._sharding_signature(dev_inputs, dev_labels)}"
+        )
+        program = self._compiled_steps.get(sig)
+        if program is not None:
+            return program
+        store = aot.get()
+        program, outcome, seconds = store.load_or_compile_ex(
+            "train-step", self._jit_train_step,
+            self.params, self.opt_state, dev_inputs, dev_labels,
+            self.global_step,
+            geometry=sig, plan=aot.plan_signature(self.plan),
+            extra=self._model_signature(),
+        )
+        if outcome != "bypass":
+            self._compiled_steps[sig] = program
+            if self._aot_first_outcome is None:
+                self._aot_first_outcome = outcome
+            if self.telemetry is not None:
+                self.telemetry.observe_aot(outcome, seconds)
+        return program
+
     def _build_train_step(self):
+        # any rebuild (batch_split raise, elastic re-mesh) orphans the
+        # dispatch plane's executables — they belong to the old closure
+        self._compiled_steps.clear()
         model, loss, optimizer = self.model, self.loss, self.optimizer
         batch_split = self.batch_split
         schedule = self.scheduler
@@ -1765,7 +1860,14 @@ class Trainer:
                 xplane.on_step_start(step_i[0])
 
             t0 = time.perf_counter() if instrument else 0.0
-            self.params, self.opt_state, values = self._jit_train_step(
+            # store-enabled runs dispatch the AOT executable (a warm
+            # restart's first step LOADS it: zero XLA compiles); with the
+            # store off the jit wrapper runs exactly as before
+            step_fn = (
+                self._aot_train_step_program(dev_inputs, dev_labels)
+                if aot.get().enabled else self._jit_train_step
+            )
+            self.params, self.opt_state, values = step_fn(
                 self.params, self.opt_state, dev_inputs, dev_labels,
                 self.global_step,
             )

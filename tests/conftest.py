@@ -22,6 +22,17 @@ if "MLRT_AUTOTUNE_CACHE" not in os.environ:
         prefix="mlrt_tuning_cache_"
     )
 
+# AOT compiled-program store (ops/aot.py): same discipline — a per-run temp
+# dir keeps test-compiled executables (and the subprocess smokes') out of
+# the repo's artifacts/aot/, and keeps runs from warm-starting off each
+# other's programs.
+if "MLRT_AOT_CACHE" not in os.environ:
+    import tempfile
+
+    os.environ["MLRT_AOT_CACHE"] = tempfile.mkdtemp(
+        prefix="mlrt_aot_cache_"
+    )
+
 # Force (not setdefault: the environment may pin JAX_PLATFORMS to a TPU
 # backend) the CPU platform with 8 virtual devices for every test run.
 os.environ["JAX_PLATFORMS"] = "cpu"

@@ -1,0 +1,23 @@
+"""MLA011 clean twin: program builds route through the AOT store."""
+
+import jax
+
+from ml_recipe_tpu.ops import aot
+
+
+def build_step(step_fn, params, batch, plan):
+    # the store deserializes this on a warm restart instead of recompiling
+    return aot.get().load_or_compile(
+        "train-step", jax.jit(step_fn), params, batch,
+        geometry="8x64", plan=aot.plan_signature(plan),
+    )
+
+
+def probe(call, *arg_shapes):
+    # probe sweeps key by HLO hash so sibling candidates coexist
+    return aot.probe_compile("attn-probe", call, *arg_shapes)
+
+
+def lower_only(step_fn, params, batch):
+    # lowering without compiling (HLO inspection) is not a program build
+    return jax.jit(step_fn).lower(params, batch).as_text()

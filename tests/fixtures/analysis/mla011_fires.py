@@ -1,0 +1,19 @@
+"""MLA011 firing fixture: raw lower().compile() chains outside ops/aot.py."""
+
+import jax
+
+
+def build_step(step_fn, params, batch):
+    # a program the AOT store never sees: recompiles on every restart
+    return jax.jit(step_fn).lower(params, batch).compile()
+
+
+def probe(call, *arg_shapes):
+    compiled = jax.jit(call).lower(*arg_shapes).compile()
+    return compiled
+
+
+class Engine:
+    def warm_bucket(self, dev):
+        # method-receiver spelling fires too
+        return self._jit.lower(self.params, dev).compile()

@@ -40,6 +40,7 @@ _FIXTURE_DEST = {
     "MLA008": "ml_recipe_tpu/metrics/state_writer.py",  # artifact-path scoped
     "MLA009": "ml_recipe_tpu/train/layouts.py",  # outside-parallel/ scoped
     "MLA010": "ml_recipe_tpu/resilience/peer_view.py",  # resilience-scoped
+    "MLA011": "ml_recipe_tpu/train/warm.py",  # outside ops/aot.py scoped
 }
 
 

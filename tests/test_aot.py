@@ -1,0 +1,422 @@
+"""AOT compiled-program store tests (ops/aot.py): artifact round-trip,
+corrupt-blob recovery, key isolation across (device kind, mesh plan,
+geometry, precision suffix), the loud stale-fingerprint MISS (regression:
+a stale artifact is never deserialized), serialization-unsupported
+degradation, byte-budget eviction, and the stdlib-only inspection CLI."""
+
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ml_recipe_tpu.ops import aot
+
+pytestmark = pytest.mark.unit
+
+
+@pytest.fixture
+def store(tmp_path, monkeypatch):
+    """Fresh process-wide store on a per-test dir, device kind pinned so
+    the partition directory is deterministic."""
+    monkeypatch.setattr(aot, "_device_kind", lambda: "FakeTPU v0")
+    st = aot.reset()
+    st.enabled = True
+    st.set_cache_dir(tmp_path / "aot")
+    yield st
+    aot.reset()
+
+
+def _fresh(store):
+    """A second ProgramCache over the same disk dir — the 'new process'
+    of a warm restart (no in-memory state carries over)."""
+    return aot.ProgramCache(cache_dir=store.cache_dir, enabled=True)
+
+
+def _double(x):
+    return x * 2 + 1
+
+
+def _args():
+    return (jnp.arange(8, dtype=jnp.float32),)
+
+
+# -- round-trip + counters -----------------------------------------------------
+
+
+def test_round_trip_miss_then_warm_hit(store):
+    """First build compiles and persists; a fresh store over the same dir
+    deserializes — zero compiles, counted as a hit — and the loaded
+    executable computes the same answer."""
+    compiled, outcome, _ = store.load_or_compile_ex(
+        "unit-step", jax.jit(_double), *_args(), geometry="8")
+    assert outcome == "miss"
+    assert store.misses == 1 and store.hits == 0
+    expect = np.asarray(compiled(*_args()))
+
+    warm = _fresh(store)
+    loaded, outcome, seconds = warm.load_or_compile_ex(
+        "unit-step", jax.jit(_double), *_args(), geometry="8")
+    assert outcome == "hit"
+    assert warm.hits == 1 and warm.misses == 0  # the zero-compile restart
+    assert warm.load_times_s and seconds >= 0
+    np.testing.assert_array_equal(np.asarray(loaded(*_args())), expect)
+
+
+def test_session_summary_states(store):
+    assert store.session_summary()["cache"] == "unused"
+    store.load_or_compile("unit-step", jax.jit(_double), *_args())
+    assert store.session_summary()["cache"] == "miss"
+    warm = _fresh(store)
+    warm.load_or_compile("unit-step", jax.jit(_double), *_args())
+    summary = warm.session_summary()
+    assert summary["cache"] == "hit" and summary["hits"] == 1
+    assert summary["events"][0]["outcome"] == "hit"
+    disabled = aot.ProgramCache(cache_dir=store.cache_dir, enabled=False)
+    assert disabled.session_summary()["cache"] == "disabled"
+
+
+def test_disabled_store_bypasses_and_writes_nothing(store):
+    store.enabled = False
+    compiled, outcome, _ = store.load_or_compile_ex(
+        "unit-step", jax.jit(_double), *_args())
+    assert outcome == "bypass" and store.bypass == 1
+    np.testing.assert_array_equal(
+        np.asarray(compiled(*_args())), np.asarray(_double(_args()[0])))
+    assert not list(store.cache_dir.rglob("*.aot"))
+
+
+# -- corrupt-artifact recovery -------------------------------------------------
+
+
+def _one_artifact(store):
+    store.load_or_compile("unit-step", jax.jit(_double), *_args())
+    (path,) = store.cache_dir.rglob("*.aot")
+    return path
+
+
+def test_truncated_blob_recovers(store, caplog):
+    path = _one_artifact(store)
+    path.write_bytes(path.read_bytes()[:-10])
+    with caplog.at_level(logging.WARNING, logger="ml_recipe_tpu.ops.aot"):
+        warm = _fresh(store)
+        _, outcome, _ = warm.load_or_compile_ex(
+            "unit-step", jax.jit(_double), *_args())
+    assert outcome == "miss"
+    assert any("corrupt" in r.message for r in caplog.records)
+    # the recompile's store attempt replaced the corrupt artifact
+    header, _, problem = aot._read_artifact(path)
+    assert problem is None and header["name"] == "unit-step"
+
+
+@pytest.mark.parametrize("mangle", [
+    lambda raw: b"JUNK" + raw[4:],                     # bad magic
+    lambda raw: raw[:len(aot._MAGIC)] + b"{tornjson",  # torn header
+    lambda raw: raw[:-1] + bytes([raw[-1] ^ 0xFF]),    # checksum mismatch
+])
+def test_mangled_artifact_is_a_miss_not_a_crash(store, mangle):
+    path = _one_artifact(store)
+    path.write_bytes(mangle(path.read_bytes()))
+    warm = _fresh(store)
+    compiled, outcome, _ = warm.load_or_compile_ex(
+        "unit-step", jax.jit(_double), *_args())
+    assert outcome == "miss"
+    np.testing.assert_array_equal(
+        np.asarray(compiled(*_args())), np.asarray(_double(_args()[0])))
+
+
+# -- key isolation -------------------------------------------------------------
+
+
+def test_key_isolation_device_kind_geometry_plan_extra(store, monkeypatch):
+    """One artifact per (device kind, geometry, plan, extra) — a program
+    compiled for one chip/mesh/bucket/precision never answers another's
+    lookup."""
+    store.load_or_compile("step", jax.jit(_double), *_args(),
+                          geometry="8x64", plan="data4", extra="")
+    store.load_or_compile("step", jax.jit(_double), *_args(),
+                          geometry="8x128", plan="data4", extra="")
+    store.load_or_compile("step", jax.jit(_double), *_args(),
+                          geometry="8x64", plan="data2-model2", extra="")
+    store.load_or_compile("step", jax.jit(_double), *_args(),
+                          geometry="8x64", plan="data4", extra="q8")
+    monkeypatch.setattr(aot, "_device_kind", lambda: "OtherTPU v9")
+    store.load_or_compile("step", jax.jit(_double), *_args(),
+                          geometry="8x64", plan="data4", extra="")
+    paths = sorted(p.relative_to(store.cache_dir).as_posix()
+                   for p in store.cache_dir.rglob("*.aot"))
+    assert len(paths) == 5 and len(set(paths)) == 5
+    assert store.misses == 5
+    kinds = {p.split("/")[0] for p in paths}
+    assert kinds == {"FakeTPU_v0", "OtherTPU_v9"}
+
+    # and each key warm-hits its own artifact
+    monkeypatch.setattr(aot, "_device_kind", lambda: "FakeTPU v0")
+    warm = _fresh(store)
+    for geometry, plan, extra in [("8x64", "data4", ""),
+                                  ("8x128", "data4", ""),
+                                  ("8x64", "data2-model2", ""),
+                                  ("8x64", "data4", "q8")]:
+        _, outcome, _ = warm.load_or_compile_ex(
+            "step", jax.jit(_double), *_args(),
+            geometry=geometry, plan=plan, extra=extra)
+        assert outcome == "hit", (geometry, plan, extra)
+    assert warm.hits == 4 and warm.misses == 0
+
+
+def test_empty_key_parts_do_not_collide(store):
+    store.load_or_compile("step", jax.jit(_double), *_args(),
+                          geometry="", plan="x")
+    store.load_or_compile("step", jax.jit(_double), *_args(),
+                          geometry="x", plan="")
+    assert len(list(store.cache_dir.rglob("*.aot"))) == 2
+
+
+def test_key_by_hlo_keeps_sibling_probes_apart(store):
+    """Probe discipline: two candidates at IDENTICAL argument shapes get
+    distinct artifacts (the geometry is baked into the program, not the
+    args), so a sweep never stale-invalidates its own siblings."""
+    store.load_or_compile("probe", jax.jit(lambda x: x * 2), *_args(),
+                          key_by_hlo=True)
+    store.load_or_compile("probe", jax.jit(lambda x: x * 3), *_args(),
+                          key_by_hlo=True)
+    assert len(list(store.cache_dir.rglob("*.aot"))) == 2
+    warm = _fresh(store)
+    _, outcome, _ = warm.load_or_compile_ex(
+        "probe", jax.jit(lambda x: x * 3), *_args(), key_by_hlo=True)
+    assert outcome == "hit"
+
+
+def test_plan_signature():
+    class Plan:
+        def describe(self):
+            return {"data": 4, "model": 2}
+
+    assert aot.plan_signature(Plan()) == "data4-model2"
+    assert aot.plan_signature({"data": 8}) == "data8"
+    assert aot.plan_signature(None) == ""
+
+
+# -- stale-fingerprint invalidation (the ISSUE regression test) ----------------
+
+
+def test_stale_salt_misses_loudly_and_never_deserializes(
+    store, monkeypatch, caplog,
+):
+    """Regression: a fingerprint mismatch must (a) log ONE warning naming
+    the changed component and (b) recompile WITHOUT attempting to
+    deserialize the stale blob. ``_deserialize`` raising pins (b): had the
+    stale blob reached it, the miss reason would read ``deserialize``,
+    not ``stale:code`` (the store's own write-validation also routes
+    through ``_deserialize``, so persistence is exercised separately
+    below)."""
+    _one_artifact(store)
+    monkeypatch.setenv(aot.ENV_SALT, "fleet-invalidate-2026")
+    monkeypatch.setattr(
+        aot, "_deserialize",
+        lambda payload: (_ for _ in ()).throw(
+            RuntimeError("deserialize was reached")))
+    warm = _fresh(store)
+    with caplog.at_level(logging.WARNING, logger="ml_recipe_tpu.ops.aot"):
+        _, outcome, _ = warm.load_or_compile_ex(
+            "unit-step", jax.jit(_double), *_args())
+    assert outcome == "miss"
+    stale_lines = [r.message for r in caplog.records if "MISS (stale)" in r.message]
+    assert len(stale_lines) == 1
+    assert "component=code" in stale_lines[0]
+    (event,) = warm.session_summary()["events"]
+    assert event["reason"] == "stale:code"
+
+    # with deserialization working, the recompile re-stores under the NEW
+    # fingerprint and salted lookups hit
+    monkeypatch.setattr(aot, "_deserialize", _real_deserialize)
+    rebuild = _fresh(store)
+    _, outcome, _ = rebuild.load_or_compile_ex(
+        "unit-step", jax.jit(_double), *_args())
+    assert outcome == "miss"
+    salted = _fresh(store)
+    _, outcome, _ = salted.load_or_compile_ex(
+        "unit-step", jax.jit(_double), *_args())
+    assert outcome == "hit"
+
+
+_real_deserialize = aot._deserialize
+
+
+def test_jax_version_component_invalidates(store, monkeypatch, caplog):
+    _one_artifact(store)
+    monkeypatch.setattr(aot, "_jax_versions", lambda: ("99.0", "99.0"))
+    warm = _fresh(store)
+    with caplog.at_level(logging.WARNING, logger="ml_recipe_tpu.ops.aot"):
+        _, outcome, _ = warm.load_or_compile_ex(
+            "unit-step", jax.jit(_double), *_args())
+    assert outcome == "miss"
+    (line,) = [r.message for r in caplog.records if "MISS (stale)" in r.message]
+    assert "component=jax" in line and "component=jaxlib" in line
+
+
+def test_hlo_change_invalidates_exactly(store):
+    """A semantically different program at the SAME filename key misses
+    on the hlo component (e.g. a different closure constant)."""
+    store.load_or_compile("step", jax.jit(lambda x: x * 2), *_args())
+    warm = _fresh(store)
+    _, outcome, _ = warm.load_or_compile_ex(
+        "step", jax.jit(lambda x: x * 3), *_args())
+    assert outcome == "miss"
+    (event,) = warm.session_summary()["events"]
+    assert event["reason"] == "stale:hlo"
+
+
+# -- serialization-unsupported degradation -------------------------------------
+
+
+def test_serialize_unsupported_degrades_loudly_once(store, monkeypatch, caplog):
+    def boom(compiled):
+        raise RuntimeError("backend cannot serialize")
+
+    monkeypatch.setattr(aot, "_serialize", boom)
+    with caplog.at_level(logging.WARNING, logger="ml_recipe_tpu.ops.aot"):
+        c1, o1, _ = store.load_or_compile_ex(
+            "step", jax.jit(_double), *_args())
+        c2, o2, _ = store.load_or_compile_ex(
+            "step2", jax.jit(_double), *_args())
+    assert (o1, o2) == ("miss", "miss")  # training proceeds, just compiles
+    np.testing.assert_array_equal(
+        np.asarray(c1(*_args())), np.asarray(_double(_args()[0])))
+    assert not list(store.cache_dir.rglob("*.aot"))
+    warnings = [r for r in caplog.records if "cannot serialize" in r.message]
+    assert len(warnings) == 1  # loud-once latch
+
+
+def test_deserialize_unsupported_falls_back_to_compile(
+    store, monkeypatch, caplog,
+):
+    _one_artifact(store)
+
+    def boom(payload):
+        raise RuntimeError("runtime cannot deserialize")
+
+    monkeypatch.setattr(aot, "_deserialize", boom)
+    warm = _fresh(store)
+    with caplog.at_level(logging.WARNING, logger="ml_recipe_tpu.ops.aot"):
+        compiled, outcome, _ = warm.load_or_compile_ex(
+            "unit-step", jax.jit(_double), *_args())
+    assert outcome == "miss"
+    np.testing.assert_array_equal(
+        np.asarray(compiled(*_args())), np.asarray(_double(_args()[0])))
+    assert any("cannot deserialize" in r.message for r in caplog.records)
+
+
+def test_store_validates_round_trip_before_persisting(
+    store, monkeypatch, caplog,
+):
+    """A blob that serializes but cannot deserialize (the known source: a
+    program XLA's own persistent compile cache served — its serialized
+    form references symbols the payload does not carry) must NOT be
+    persisted: the store stays hit-or-absent, never
+    warn-and-recompile-forever."""
+    def boom(payload):
+        raise RuntimeError("Symbols not found")
+
+    monkeypatch.setattr(aot, "_deserialize", boom)
+    with caplog.at_level(logging.WARNING, logger="ml_recipe_tpu.ops.aot"):
+        _, outcome, _ = store.load_or_compile_ex(
+            "step", jax.jit(_double), *_args())
+    assert outcome == "miss"  # the compile itself is unaffected
+    assert not list(store.cache_dir.rglob("*.aot"))
+    assert any("not persisting" in r.message for r in caplog.records)
+
+
+def test_compile_errors_propagate(store):
+    """The store must not swallow compile failures — kernel probes
+    classify them (VMEM overflow vs bug)."""
+    def bad(x):
+        return jnp.reshape(x, (3, 5))  # 8 elements into 15: shape error
+
+    with pytest.raises(Exception):
+        store.load_or_compile("bad", jax.jit(bad), *_args())
+
+
+# -- parse_bytes + eviction ----------------------------------------------------
+
+
+def test_parse_bytes():
+    assert aot.parse_bytes(None) is None
+    assert aot.parse_bytes("") is None
+    assert aot.parse_bytes(0) is None
+    assert aot.parse_bytes(1048576) == 1 << 20
+    assert aot.parse_bytes("512") == 512
+    assert aot.parse_bytes("4K") == 4096
+    assert aot.parse_bytes("512M") == 512 << 20
+    assert aot.parse_bytes("2g") == 2 << 30
+    assert aot.parse_bytes("512MB") == 512 << 20
+    with pytest.raises(ValueError, match="unparseable"):
+        aot.parse_bytes("lots")
+
+
+def _plant(cache_dir, name, size, mtime):
+    path = cache_dir / "FakeTPU_v0" / f"{name}.aot"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_bytes(b"x" * size)
+    os.utime(path, (mtime, mtime))
+    return path
+
+
+def test_evict_to_budget_drops_oldest_first(tmp_path):
+    old = _plant(tmp_path, "old", 600, 1000)
+    mid = _plant(tmp_path, "mid", 600, 2000)
+    new = _plant(tmp_path, "new", 600, 3000)
+    removed = aot.evict_to_budget(tmp_path, 1300)
+    assert removed == [old]
+    assert not old.exists() and mid.exists() and new.exists()
+    assert aot.evict_to_budget(tmp_path, None) == []  # unbounded no-op
+
+
+def test_store_enforces_budget_on_write(store):
+    store.cache_bytes = 1  # absurdly small: every write evicts the rest
+    store.load_or_compile("a", jax.jit(lambda x: x * 2), *_args())
+    store.load_or_compile("b", jax.jit(lambda x: x * 3), *_args())
+    assert store.evictions >= 1
+    assert len(list(store.cache_dir.rglob("*.aot"))) <= 1
+
+
+# -- inspection CLI (in-process: main() is stdlib-only) ------------------------
+
+
+def test_cli_list_empty_and_populated(store, capsys):
+    assert aot.main(["--cache_dir", str(store.cache_dir), "--list"]) == 0
+    assert "empty" in capsys.readouterr().out
+    _one_artifact(store)
+    assert aot.main(["--cache_dir", str(store.cache_dir), "--list"]) == 0
+    out = capsys.readouterr().out
+    assert "unit-step" in out and "total: 1 artifact(s)" in out
+    assert "code=" in out and "hlo=" in out  # fingerprint shown
+
+
+def test_cli_verify_reports_corruption_without_deleting(store, capsys):
+    good = _one_artifact(store)
+    bad = store.cache_dir / "FakeTPU_v0" / "bad--x----.aot"
+    bad.write_bytes(b"not an artifact")
+    assert aot.main(["--cache_dir", str(store.cache_dir), "--verify"]) == 1
+    out = capsys.readouterr().out
+    assert "1 ok, 1 corrupt" in out and "bad magic" in out.lower()
+    assert bad.exists() and good.exists()  # verify reports, never deletes
+    bad.unlink()
+    assert aot.main(["--cache_dir", str(store.cache_dir), "--verify"]) == 0
+
+
+def test_cli_evict(store, capsys):
+    _plant(store.cache_dir, "old", 600, 1000)
+    _plant(store.cache_dir, "new", 600, 2000)
+    assert aot.main(["--cache_dir", str(store.cache_dir), "--evict",
+                     "--aot_cache_bytes", "1K"]) == 0
+    out = capsys.readouterr().out
+    assert "evicted 1 artifact(s)" in out and "old" in out
+
+
+def test_cli_evict_requires_budget(store):
+    with pytest.raises(SystemExit):
+        aot.main(["--cache_dir", str(store.cache_dir), "--evict"])

@@ -314,6 +314,86 @@ def test_sequence_packing_off_bit_matches_head(tmp_path):
         )
 
 
+def _run_aot(trainer):
+    """``_run`` for store-enabled trainers: record losses AROUND the
+    AOT-dispatched executable instead of swapping ``_jit_train_step`` for
+    a plain function (which cannot ``.lower()`` and would make the
+    trainer bypass the store entirely — exactly what these pins must not
+    do)."""
+    losses = []
+    real = trainer._aot_train_step_program
+
+    def recording_program(dev_inputs, dev_labels):
+        program = real(dev_inputs, dev_labels)
+
+        def rec(params, opt_state, inputs, labels, step):
+            out = program(params, opt_state, inputs, labels, step)
+            losses.append(float(jax.device_get(out[2]["loss"])))
+            return out
+
+        return rec
+
+    trainer._aot_train_step_program = recording_program
+    trainer.train()
+    return losses, _param_snapshot(trainer.params)
+
+
+def test_aot_cache_off_bit_matches_enabled_store(tmp_path):
+    """ISSUE-17 acceptance: ``--aot_cache off`` (the store disabled — the
+    HEAD jit-dispatch path verbatim) and BOTH store outcomes — a cold run
+    against an empty store (miss: store-owned compile) and a warm restart
+    (hit: the deserialized executable, zero XLA compiles) — must produce
+    bit-identical loss trajectories and final params."""
+    from ml_recipe_tpu.ops import aot
+
+    store_dir = tmp_path / "store"
+
+    def fresh(sub):
+        d = tmp_path / sub
+        d.mkdir()
+        t, _ = _make_trainer(d, mesh_spec="data:8", dropout=0.0, n_epochs=2)
+        return t
+
+    try:
+        aot.reset().enabled = False  # --aot_cache off
+        off = _run(fresh("off"))
+        assert aot.get().hits == 0 and aot.get().misses == 0
+
+        aot.reset()
+        aot.configure(enabled=True, cache_dir=store_dir)
+        cold = _run_aot(fresh("cold"))
+        store = aot.get()
+        assert store.misses >= 1 and store.hits == 0, (
+            "empty store must cold-compile (and persist) every program"
+        )
+
+        aot.reset()
+        aot.configure(enabled=True, cache_dir=store_dir)
+        warm = _run_aot(fresh("warm"))
+        store = aot.get()
+        assert store.misses == 0 and store.hits >= 1, (
+            "warm restart must deserialize every program: zero XLA compiles"
+        )
+    finally:
+        aot.reset()
+
+    for name, (losses, params) in (("cold", cold), ("warm", warm)):
+        losses_o, params_o = off
+        assert len(losses) == len(losses_o) >= 4
+        assert losses == losses_o, (
+            f"{name}-store loss trajectory not bit-identical to --aot_cache off"
+        )
+        for x, y in zip(
+            jax.tree_util.tree_leaves(params),
+            jax.tree_util.tree_leaves(params_o),
+        ):
+            np.testing.assert_array_equal(
+                x, y,
+                err_msg=f"{name}-store final params not bit-identical "
+                        "to --aot_cache off",
+            )
+
+
 def test_pipe2_matches_data4(tmp_path):
     """ISSUE-15 acceptance: ``--mesh data:2,pipe:2`` trains the SAME
     trajectory as ``data:4`` at identical data order — the GPipe schedule

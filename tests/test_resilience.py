@@ -1022,6 +1022,176 @@ def test_chaos_kill_mid_async_persist(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Warm-pool chaos (ISSUE 17): supervised kill -> resume where the resumed
+# attempt deserializes its program from the AOT store instead of compiling.
+# ---------------------------------------------------------------------------
+
+_AOT_WARM_CHILD_SCRIPT = textwrap.dedent(
+    """
+    import sys, time
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from ml_recipe_tpu.metrics.goodput import GoodputLedger
+    from ml_recipe_tpu.ops import aot
+    from ml_recipe_tpu.resilience import faults
+    from ml_recipe_tpu.train.checkpoint import (
+        load_state_dict, peek_global_step, save_state_dict_sharded,
+    )
+
+    ckpt = sys.argv[1]
+    n_steps = int(sys.argv[2])
+    ledger_path = sys.argv[3]
+
+    params = {"w": np.zeros((16, 16), dtype=np.float32)}
+    start = 0
+    if peek_global_step(ckpt) is not None:
+        params, _, _, got = load_state_dict(ckpt, params=params)
+        start = got or 0
+
+    ledger = GoodputLedger(ledger_path, flush_every=1)
+    ledger.note_run_start(start + 1)
+
+    def loss(w, x):
+        h = jnp.tanh(x @ w)
+        return jnp.sum(jnp.tanh(h @ w) ** 2)
+
+    def step_fn(w, x):
+        return w - 0.01 * jax.grad(loss)(w, x)
+
+    x = jnp.ones((16, 16), dtype=jnp.float32)
+    store = aot.get()
+    t0 = time.perf_counter()
+    program = store.load_or_compile(
+        "chaos-step", jax.jit(step_fn), jnp.asarray(params["w"]), x,
+        geometry="16x16", plan="data1",
+    )
+    build_s = time.perf_counter() - t0
+    # per-attempt tally: the resumed attempt's event must show misses == 0
+    ledger.note_aot(store.hits, store.misses, sum(store.load_times_s))
+
+    w = jnp.asarray(params["w"])
+    for step in range(start + 1, n_steps + 1):
+        t0 = time.perf_counter()
+        faults.fire("trainer.step")
+        w = program(w, x)
+        np.asarray(w)
+        first = step == start + 1
+        ledger.note_step(
+            step,
+            wall_s=(time.perf_counter() - t0) + (build_s if first else 0.0),
+            compile=first,
+            aot_hit=(store.misses == 0) if first else None,
+        )
+        save_state_dict_sharded(
+            ckpt, params={"w": np.asarray(w)}, global_step=step
+        )
+    ledger.note_run_end(n_steps)
+    print(f"DONE step={n_steps}")
+    """
+)
+
+
+def test_chaos_warm_pool_restart_is_zero_compile(tmp_path):
+    """ISSUE-17 acceptance: kill a supervised attempt after its first step
+    and let the supervisor resume. The replacement attempt must perform
+    ZERO XLA compiles — its ledger ``aot`` event shows ``misses == 0`` —
+    its compile_warmup window must be the artifact-load time (a fraction
+    of the cold attempt's real compile), and the goodput partition must
+    stay exact across the crash."""
+    from ml_recipe_tpu.metrics.goodput import (
+        BADPUT_CATEGORIES,
+        read_ledger,
+        summarize_events,
+    )
+    from ml_recipe_tpu.train.checkpoint import peek_global_step
+
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    script = run_dir / "child.py"
+    script.write_text(_AOT_WARM_CHILD_SCRIPT)
+    ckpt = str(run_dir / "state.ckpt")
+    ledger_path = str(run_dir / "goodput.jsonl")
+    log = run_dir / "child.log"
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MLRT_FAULTS"] = "trainer.step:kill@2!once"
+    env["MLRT_FAULT_STATE"] = str(run_dir / "fault-state")
+    # a dedicated store dir shared ONLY by this drill's attempts, and a
+    # fresh XLA compile cache so attempt 1's compile is genuinely cold —
+    # the cold-vs-warm compile_warmup comparison below depends on both
+    env["MLRT_AOT_CACHE"] = str(run_dir / "aot")
+    env["JAX_COMPILATION_CACHE_DIR"] = str(run_dir / "xla-cache")
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+
+    def launch(attempt_i):
+        fh = open(log, "ab")
+        return subprocess.Popen(
+            [sys.executable, str(script), ckpt, "3", ledger_path],
+            env=env, cwd=REPO_ROOT, stdout=fh, stderr=fh,
+        )
+
+    sup = Supervisor(
+        launch,
+        progress=lambda: peek_global_step(ckpt),
+        policy=_FAST_POLICY,
+        attempt_timeout=120,
+        sleep=lambda s: None,
+        ledger_path=ledger_path,
+    )
+    result = sup.run()
+
+    assert result.status == "clean", log.read_text(errors="replace")
+    assert result.outcomes() == ["crash", "clean"]
+    assert result.attempts[0].returncode == KILL_EXIT_CODE
+    assert result.attempts[0].step_after == 1  # killed at step 2
+    assert peek_global_step(ckpt) == 3
+
+    # split the ledger at attempt boundaries: the events each child wrote
+    # after ITS run_start are that attempt's
+    events = sorted(
+        (e for e in read_ledger(ledger_path) if "t" in e),
+        key=lambda e: e["t"],
+    )
+    attempts, current = [], None
+    for e in events:
+        if e.get("ev") == "run_start":
+            current = []
+            attempts.append(current)
+        elif current is not None:
+            current.append(e)
+    assert len(attempts) == 2
+
+    cold_aot = next(e for e in attempts[0] if e["ev"] == "aot")
+    warm_aot = next(e for e in attempts[1] if e["ev"] == "aot")
+    assert cold_aot["misses"] == 1 and cold_aot["hits"] == 0
+    # THE acceptance: the resumed attempt compiled nothing
+    assert warm_aot["misses"] == 0 and warm_aot["hits"] == 1
+    assert warm_aot["load_s"] > 0
+
+    # the cold attempt's first-step window booked a real XLA compile; the
+    # warm attempt's booked an artifact load — flagged and far smaller
+    cold_win = next(e for e in attempts[0] if e["ev"] == "steps")
+    warm_win = next(e for e in attempts[1] if e["ev"] == "steps")
+    assert cold_win["aot_hit"] is False
+    assert warm_win["aot_hit"] is True
+    assert cold_win["compile_s"] > 0
+    assert warm_win["compile_s"] < cold_win["compile_s"]
+
+    # partition exactness across the crash + zero-compile resume
+    s = summarize_events(events)
+    assert s["attempts"] == 2
+    assert s["aot_hits"] == 1 and s["aot_misses"] == 1
+    accounted = s["productive_s"] + sum(
+        s["badput_s"][c] for c in BADPUT_CATEGORIES
+    )
+    assert accounted == pytest.approx(s["total_wall_s"], rel=1e-9, abs=1e-9)
+    assert s["badput_s"]["restart_downtime"] > 0
+
+
+# ---------------------------------------------------------------------------
 # Full CLI drill (slow tier): --supervise end-to-end through cli.train
 # ---------------------------------------------------------------------------
 
