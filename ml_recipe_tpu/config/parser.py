@@ -968,6 +968,79 @@ def get_serve_parser() -> ConfigArgumentParser:
     return parser
 
 
+def get_fleet_parser() -> ConfigArgumentParser:
+    """Serving-fleet config ([fleet] surface): router tier size, ring
+    geometry, health-driven shedding thresholds, rolling restarts. The
+    fleet CLI composes this with the serve + model parsers — serve flags
+    (buckets, caches, drain budget, --host/--port for the ROUTER bind)
+    are forwarded to every engine child."""
+    parser = ConfigArgumentParser(description="Fleet config parser.", add_help=False)
+
+    parser.add_argument("-c", "--config_file", required=False, is_config_file=True,
+                        help="Config file path.")
+    parser.add_argument("--fleet_config_file", required=False, is_config_file=True,
+                        help="Fleet config file path.")
+
+    parser.add_argument("--engines", type=int, default=2,
+                        help="Engine processes behind the router. Each is "
+                             "one ml_recipe_tpu.cli.serve child on an "
+                             "ephemeral port, launched against the shared "
+                             "AOT program store.")
+    parser.add_argument("--engine_checkpoints", type=cast2(str), default=None,
+                        help="Comma list of checkpoint paths assigned "
+                             "per-engine (1 entry = every engine, N "
+                             "entries = one each — multi-checkpoint A/B "
+                             "routing in one tier; the checkpoint-"
+                             "fingerprint cache keys isolate results). "
+                             "None = every engine uses --checkpoint.")
+    parser.add_argument("--ring_replicas", type=int, default=64,
+                        help="Virtual nodes per engine on the consistent-"
+                             "hash ring (bounded; health weighting scales "
+                             "a node's share of them).")
+    parser.add_argument("--health_poll_s", type=float, default=1.0,
+                        help="Router health-poll interval: every engine's "
+                             "/healthz (status + queue depth) is polled "
+                             "this often; ejection latency for a dead "
+                             "engine is bounded by eject_after polls.")
+    parser.add_argument("--eject_after", type=int, default=2,
+                        help="Consecutive health failures before an engine "
+                             "is ejected from the ring (the first failure "
+                             "weight-reduces it to --degrade_weight).")
+    parser.add_argument("--degrade_weight", type=float, default=0.25,
+                        help="Ring weight of a degraded engine (failing "
+                             "polls, 429/503 answers, or queue pressure "
+                             "past --queue_pressure).")
+    parser.add_argument("--queue_pressure", type=float, default=0.75,
+                        help="Queue-depth fraction of an engine's bounded "
+                             "queue past which the router weight-reduces "
+                             "it (healthy-but-saturated: load is moved, "
+                             "no ejection counter advances).")
+    parser.add_argument("--spill_retries", type=int, default=1,
+                        help="Ring successors to try after the owning "
+                             "engine refuses a request (connection error, "
+                             "429, 503). Only when every candidate "
+                             "refuses does the router shed with 503 + "
+                             "Retry-After.")
+    parser.add_argument("--routing", type=str, default="hash",
+                        choices=["hash", "random"],
+                        help="Request routing policy: 'hash' pins each "
+                             "document's traffic to one engine via the "
+                             "consistent-hash ring (cache affinity), "
+                             "'random' scatters uniformly (the bench "
+                             "baseline).")
+    parser.add_argument("--rolling_restart", type=_str2bool, default=False,
+                        help="After the tier is ready, perform one rolling "
+                             "restart pass (drain -> relaunch off the "
+                             "shared AOT store with zero compiles "
+                             "asserted -> re-admit, one engine at a "
+                             "time), then keep serving.")
+    parser.add_argument("--fleet_run_dir", type=cast2(str), default=None,
+                        help="Directory for engine ready files + logs "
+                             "(None = a fresh temp dir).")
+
+    return parser
+
+
 def resolve_precision(params) -> str:
     """Map (precision, apex_level) onto the native policy: 'bf16' or 'f32'."""
     if getattr(params, "precision", None):

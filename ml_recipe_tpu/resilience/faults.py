@@ -96,6 +96,11 @@ KNOWN_SITES = (
     "dist.barrier",          # inside every named cross-process barrier
     "trainer.step",          # host side of each train step
     "trainer.eval_step",     # host side of each eval step
+    "fleet.engine",          # serving engine, per admitted /v1/qa request
+                             # (fleet chaos drills: kill one engine of a
+                             # router tier mid-load; scope with %hostN —
+                             # the fleet manager stamps MLRT_HOST with the
+                             # engine index)
 )
 
 _KINDS = ("kill", "raise", "stall", "slow")

@@ -136,8 +136,13 @@ _REQUEST_IDS = itertools.count(1)
 class RequestTicket:
     """Completion handle for one submitted request."""
 
-    def __init__(self, *, n_chunks: int, question_len: int):
-        self.request_id = next(_REQUEST_IDS)
+    def __init__(self, *, n_chunks: int, question_len: int,
+                 request_id: Optional[str] = None):
+        # a router-forwarded id (fleet/router.py X-Request-Id) keeps this
+        # request's trace spans joinable across the hop; local submissions
+        # draw from the engine-wide monotonic counter
+        self.request_id = request_id if request_id is not None \
+            else next(_REQUEST_IDS)
         self.n_chunks = n_chunks
         self.question_len = question_len
         self.created_at = time.perf_counter()
@@ -715,17 +720,21 @@ class QAEngine:
         self._doc_cache.put(win_key, records, cost)
         return records
 
-    def submit(self, question: str, document: str) -> RequestTicket:
+    def submit(self, question: str, document: str,
+               request_id: Optional[str] = None) -> RequestTicket:
         """Chunk + admit one request; returns a completion ticket.
+
+        ``request_id`` overrides the engine-local id (the fleet router
+        forwards its own so per-hop latency joins on one key).
 
         Raises :class:`RequestRejected` (client error),
         :class:`QueueFullError` (backpressure) or :class:`DrainingError`
         (shutting down)."""
         tracer = trace_mod.current()
         if tracer is None:
-            return self._submit(question, document)
+            return self._submit(question, document, request_id)
         t0 = tracer.now()
-        ticket = self._submit(question, document)
+        ticket = self._submit(question, document, request_id)
         tracer.complete(
             "admission", t0, tracer.now(), cat="serve",
             args={"request_id": ticket.request_id,
@@ -733,7 +742,8 @@ class QAEngine:
         )
         return ticket
 
-    def _submit(self, question: str, document: str) -> RequestTicket:
+    def _submit(self, question: str, document: str,
+                request_id: Optional[str] = None) -> RequestTicket:
         if self._closed:
             self.m_rejected_draining.inc()
             raise DrainingError("engine is shut down")
@@ -783,7 +793,8 @@ class QAEngine:
             )
 
         ticket = RequestTicket(
-            n_chunks=len(records), question_len=len(enc_q))
+            n_chunks=len(records), question_len=len(enc_q),
+            request_id=request_id)
         rows: List[Tuple[int, int, List[int]]] = []
         for idx, rec in enumerate(records):
             input_ids = assemble_input_ids(

@@ -33,6 +33,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..metrics import trace as trace_mod
+from ..resilience import faults
 from .batcher import DrainingError, QueueFullError
 from .engine import QAEngine, RequestRejected
 
@@ -76,9 +77,15 @@ class _QAHandler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 - http.server API
         if self.path == "/healthz":
             status = "draining" if self.server.draining else "ok"
+            engine = self.server.engine
             self._send_json(200, {
                 "status": status,
-                "buckets": [str(b) for b in self.server.engine.grid],
+                "buckets": [str(b) for b in engine.grid],
+                # queue pressure for the fleet router's health-driven
+                # shedding (fleet/router.py polls this instead of parsing
+                # the full /metrics page)
+                "queue_depth": int(engine.m_queue_depth.value),
+                "queue_limit": int(engine.batcher.queue_size),
             })
         elif self.path == "/metrics":
             self._send_text(
@@ -123,12 +130,22 @@ class _QAHandler(BaseHTTPRequestHandler):
             )
             return
 
+        # fleet chaos site: 'fleet.engine:kill@N' (resilience/faults.py)
+        # kills this engine process on its Nth admitted request — the
+        # drill that proves the router ejects a dead engine mid-load
+        faults.fire("fleet.engine")
+
+        # a fleet-router hop forwards its own request id; threading it
+        # through the ticket keeps the trace spans joinable across hops
+        request_id = self.headers.get("X-Request-Id") or None
+
         # the 200 send happens INSIDE the in-flight window: the drain path
         # waits on this counter, so decrementing before the response bytes
         # are written would let the process exit mid-write
         self.server.handler_began()
         try:
-            ticket = self.server.engine.submit(question, document)
+            ticket = self.server.engine.submit(
+                question, document, request_id=request_id)
             # 'respond' span: admission done -> response bytes written (the
             # handler-side wait the client actually experiences)
             with trace_mod.span(
@@ -136,7 +153,9 @@ class _QAHandler(BaseHTTPRequestHandler):
                 args={"request_id": ticket.request_id},
             ):
                 result = ticket.result(timeout=self.server.request_timeout_s)
-                self._send_json(200, result.to_json())
+                payload = result.to_json()
+                payload["request_id"] = ticket.request_id
+                self._send_json(200, payload)
         except QueueFullError as e:
             self._send_json(
                 429, {"error": f"queue full: {e}"},

@@ -319,6 +319,54 @@ def test_bench_serve_hot_set_workload_pins_cache_win(bench, capsys):
     assert parsed["p50_hit_ms"] * 5 <= parsed["p50_miss_ms"], parsed
 
 
+def test_bench_fleet_pins_affinity_cache_win(bench, capsys):
+    """ISSUE-18 acceptance: ``bench.py --mode fleet`` runs the SAME seeded
+    zipf schedule through a consistent-hash tier and a random-routing tier
+    and the doc-cache hit-rate delta rides the JSON line, pinned >= 0.1 —
+    a conservative floor; with 2 engines and 8 zipf docs the analytic win
+    (random routing pays one first-touch miss per engine per document,
+    hashing pays one per document) lands well above it. serve_clients=1
+    keeps the request order, and so both hit rates, fully deterministic."""
+    import types
+
+    args = types.SimpleNamespace(
+        model="bert-tiny",
+        serve_buckets="4x64",
+        serve_clients=1,
+        serve_requests=24,
+        serve_queue_size=32,
+        fleet_engines=2,
+        fleet_docs=8,
+        max_batch_delay_ms=5.0,
+        doc_stride=32,
+        ln_impl="xla",
+        hbm_preflight=False,
+    )
+    bench.bench_fleet(args)
+    out = capsys.readouterr().out.strip().splitlines()
+    parsed = json.loads(out[-1])
+    assert parsed["metric"] == "bert-tiny_qa_fleet_p95_ms"
+    assert parsed["unit"] == "ms"
+    assert parsed["value"] == parsed["hash"]["p95_ms"]
+    assert parsed["engines"] == 2 and parsed["docs"] == 8
+    # tier-1 doc cache defaults ON in fleet mode (the affinity target)
+    assert parsed["doc_cache_bytes"] == 1 << 20
+    for routing in ("hash", "random"):
+        run = parsed[routing]
+        assert run["routing"] == routing
+        assert run["requests"] == 24 and run["failed"] == 0
+        assert run["spilled"] == 0 and run["shed"] == 0
+        assert run["p50_ms"] > 0
+        assert run["p50_ms"] <= run["p95_ms"] <= run["p99_ms"]
+        assert sum(run["per_engine_requests"].values()) == 24
+        assert 0 <= run["doc_cache_hit_rate"] <= 1
+    # the acceptance pin: consistent hashing beats random routing on
+    # doc-cache hit rate by a margin, not a rounding error
+    assert parsed["doc_cache_hit_rate_delta"] >= 0.1, parsed
+    assert (parsed["hash"]["doc_cache_hit_rate"]
+            > parsed["random"]["doc_cache_hit_rate"])
+
+
 def test_bench_input_packed_pass_pins_waste_reduction(bench, capsys):
     """ISSUE-5 acceptance: the sequence-packed loader pass of ``bench.py
     --mode input`` on the synthetic NQ mix (the recorded 45.7% -> 12.1%

@@ -137,11 +137,12 @@ def _bench_flags():
 
 def test_all_parser_flags_documented_in_readme():
     """ISSUE-5 satellite (extended by ISSUE 12 to bench.py): every
-    ``add_argument`` flag in the four config/parser.py factories AND in
+    ``add_argument`` flag in the five config/parser.py factories AND in
     bench.py's inline parser must appear in README.md (a subsystem
     section or the generated "Flag reference" table) or be explicitly
     allowlisted here — so a new knob cannot land undocumented."""
     from ml_recipe_tpu.config.parser import (
+        get_fleet_parser,
         get_model_parser,
         get_predictor_parser,
         get_serve_parser,
@@ -154,7 +155,8 @@ def test_all_parser_flags_documented_in_readme():
 
     flags = set()
     for factory in (get_model_parser, get_trainer_parser,
-                    get_predictor_parser, get_serve_parser):
+                    get_predictor_parser, get_serve_parser,
+                    get_fleet_parser):
         for action in factory()._actions:
             flags.update(
                 opt for opt in action.option_strings if opt.startswith("--")

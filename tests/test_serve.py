@@ -426,7 +426,7 @@ def test_http_error_mapping(stack, monkeypatch):
     assert e.value.code == 404
 
     # queue-full backpressure surfaces as 429 + Retry-After
-    def full(question, document):
+    def full(question, document, request_id=None):
         raise QueueFullError("work queue full (64/64)")
 
     monkeypatch.setattr(stack.engine, "submit", full)
@@ -613,6 +613,7 @@ def test_rolling_restart_replacement_engine_is_zero_compile(tmp_path):
                 server.shutdown()
             assert status == 200, body
             body.pop("latency_ms")  # wall-clock, legitimately differs
+            body.pop("request_id")  # process-local id, legitimately differs
             spans.append(body)
             metrics.append(
                 (engine.m_aot_hits.value, engine.m_aot_misses.value)

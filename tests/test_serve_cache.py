@@ -657,6 +657,24 @@ def test_metrics_surface_consistent_with_docs(stack):
     missing_render += [n for n in train_names if n not in rendered_train]
     missing_docs += [n for n in train_names if n not in readme]
 
+    # fleet plane (ISSUE 18): the router's own registry rides the same
+    # gate, plus the README must carry a "Serving fleet" section
+    from ml_recipe_tpu.fleet import FleetRouter
+
+    router = FleetRouter()
+    try:
+        fleet_names = router.metrics.names()
+        assert len(fleet_names) >= 12  # the full router surface
+        for prefix in ("fleet_engine", "fleet_spilled", "fleet_shed",
+                       "fleet_ejections", "fleet_hop"):
+            assert any(n.startswith(prefix) for n in fleet_names), prefix
+        rendered_fleet = router.metrics.render()
+        missing_render += [n for n in fleet_names if n not in rendered_fleet]
+        missing_docs += [n for n in fleet_names if n not in readme]
+        assert "## Serving fleet" in readme
+    finally:
+        router._httpd.server_close()  # constructed, never started
+
     assert not missing_render, (
         f"registered metrics absent from /metrics output: {missing_render}")
     assert not missing_docs, (

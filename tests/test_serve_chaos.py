@@ -34,6 +34,19 @@ _DOCUMENT = (
 )
 
 
+def _admitted_requests(url) -> int:
+    """qa_requests_total from the live /metrics page (0 if unreadable)."""
+    try:
+        with urllib.request.urlopen(f"{url}/metrics", timeout=5) as resp:
+            text = resp.read().decode("utf-8")
+    except (urllib.error.URLError, ConnectionError, OSError):
+        return 0
+    for line in text.splitlines():
+        if line.startswith("qa_requests_total"):
+            return int(float(line.split()[-1]))
+    return 0
+
+
 def _post(url, timeout=60.0):
     req = urllib.request.Request(
         f"{url}/v1/qa",
@@ -99,8 +112,35 @@ def test_serve_sigterm_drains_inflight_and_503s_late_arrivals(tmp_path):
         ]
         for t in threads:
             t.start()
-        time.sleep(0.25)  # admitted + queued (600 ms deadline still open)
+        # barrier on ADMISSION, not wall-clock: qa_requests_total increments
+        # the moment a request is admitted (still queued — the 600 ms
+        # coalescing deadline is open), so once the counter reads 4 the
+        # whole first wave is provably inside the drain guarantee. A plain
+        # sleep raced the workers: any not yet admitted got the late-arrival
+        # 503 instead and the 200-assertion below flaked.
+        admit_deadline = time.monotonic() + 60
+        while _admitted_requests(url) < 4:
+            assert time.monotonic() < admit_deadline, (
+                "first wave never fully admitted"
+            )
+            time.sleep(0.02)
         proc.send_signal(signal.SIGTERM)
+
+        # second barrier, on the DRAIN FLAG: the admission gate flips in
+        # the child's signal handler, asynchronously to send_signal — a
+        # POST racing ahead of the flip is legitimately admitted and then
+        # blocks until the 600 ms batch deadline flushes it, eating the
+        # whole drain window from this side of the socket. The first wave
+        # is still queued behind that open deadline, so the listener is
+        # provably up while we wait for /healthz to report draining.
+        while True:
+            try:
+                with urllib.request.urlopen(f"{url}/healthz", timeout=5) as r:
+                    if json.loads(r.read()).get("status") == "draining":
+                        break
+            except (urllib.error.URLError, ConnectionError, OSError):
+                pytest.fail("listener closed before draining was observable")
+            time.sleep(0.01)
 
         # late arrivals: keep posting through the drain window; clean 503s
         # until the listener closes (connection errors only AFTER that)
