@@ -1561,10 +1561,20 @@ def main() -> None:
                         help="train mode under a pipe-bearing --mesh: "
                              "comma list of micro-batch counts (e.g. "
                              "'1,2,4') to re-time at the same global "
-                             "batch; the JSON gains pipe_bubble_sweep "
-                             "with measured vs modeled (K-1)/(K-1+m) "
-                             "bubble fractions — the pipeline-efficiency "
-                             "instrument.")
+                             "batch; each point runs BOTH --pipe_schedule "
+                             "variants (gpipe and 1f1b), and the JSON "
+                             "gains pipe_bubble_sweep with measured vs "
+                             "modeled bubble fractions plus the compiled-"
+                             "program peak bytes per point — the "
+                             "pipeline-efficiency instrument.")
+    parser.add_argument("--pipe_schedule", type=str, default="gpipe",
+                        choices=["gpipe", "1f1b"],
+                        help="train mode under a pipe-bearing --mesh: tick "
+                             "schedule for the MAIN timed step (the "
+                             "micro-batch sweep always times both); 1f1b "
+                             "caps resident activations at the in-flight "
+                             "window instead of all batch_split "
+                             "microbatches.")
     parser.add_argument("--quantize", type=str, default="off",
                         choices=["off", "int8"],
                         help="infer/serve modes: post-training int8 "
@@ -1643,11 +1653,13 @@ def main() -> None:
     # test-only Trainer skips optimizer construction; build it for the bench
     from ml_recipe_tpu.train.optim import build_optimizer
 
-    def _bench_trainer(batch_split, params_tree, *, hbm_preflight):
+    def _bench_trainer(batch_split, params_tree, *, hbm_preflight,
+                       pipe_schedule="gpipe"):
         """ONE bench-trainer bootstrap for the main timed step AND the
         pipe-bubble sweep — the sweep must characterize exactly the
         optimizer-sharding configuration the user benched, only the
-        micro-batch count varies."""
+        micro-batch count (and, in the sweep, the tick schedule)
+        varies."""
         tr = Trainer(
             model=model, params=params_tree, loss=build_loss(TP()),
             collate_fun=None, trainer_params=None,
@@ -1658,6 +1670,7 @@ def main() -> None:
             zero1_overlap=args.zero1_overlap,
             zero1_bucket_mb=args.zero1_bucket_mb,
             async_checkpoint=args.async_checkpoint,
+            pipe_schedule=pipe_schedule,
         )
         tr.optimizer, tr.scheduler, tr._schedule_count = build_optimizer(
             TP(), tr.params, num_training_steps=10_000, max_grad_norm=None,
@@ -1693,7 +1706,8 @@ def main() -> None:
                     )
 
     trainer = _bench_trainer(
-        args.batch_split, params, hbm_preflight=args.hbm_preflight
+        args.batch_split, params, hbm_preflight=args.hbm_preflight,
+        pipe_schedule=args.pipe_schedule,
     )
 
     # UNSPLIT host batch: the HBM pre-flight may raise batch_split, and the
@@ -1841,52 +1855,81 @@ def main() -> None:
         if sweep_ms:
             from ml_recipe_tpu.data.bucketing import synthetic_qa_batch
             from ml_recipe_tpu.parallel.pipeline import (
+                PIPE_SCHEDULES,
                 measured_bubble_fractions,
                 modeled_bubble_fraction,
             )
+            from ml_recipe_tpu.utils.hbm import preflight_bytes
 
             sweep_in, sweep_lab = synthetic_qa_batch(B, L)
-            times = {}
+            # schedule dimension (ISSUE-19): every sweep point is timed
+            # under BOTH tick schedules, with the compiled-program peak
+            # bytes alongside — one JSON compares gpipe's m-resident
+            # activations against 1F1B's in-flight window on chip
+            times = {sched: {} for sched in PIPE_SCHEDULES}
+            peak_bytes = {sched: {} for sched in PIPE_SCHEDULES}
             for m in sweep_ms:
-                # fresh runtime-owned params per point (deterministic
-                # init): re-handing one host tree to several trainers
-                # aliases memory into donated buffers on the CPU runtime
-                # — the PR-8 heap-corruption class
-                tr_m = _bench_trainer(
-                    m,
-                    model.init(
-                        jax.random.key(0), np.zeros((1, 8), dtype=np.int32)
-                    )["params"],
-                    hbm_preflight=False,
+                for sched in PIPE_SCHEDULES:
+                    # fresh runtime-owned params per point (deterministic
+                    # init): re-handing one host tree to several trainers
+                    # aliases memory into donated buffers on the CPU
+                    # runtime — the PR-8 heap-corruption class
+                    tr_m = _bench_trainer(
+                        m,
+                        model.init(
+                            jax.random.key(0),
+                            np.zeros((1, 8), dtype=np.int32),
+                        )["params"],
+                        hbm_preflight=False,
+                        pipe_schedule=sched,
+                    )
+                    step_m = tr_m._build_train_step()
+                    di = tr_m._global_batch(
+                        tr_m._split_micro(sweep_in), leading_accum=True
+                    )
+                    dl = tr_m._global_batch(
+                        tr_m._split_micro(sweep_lab), leading_accum=True
+                    )
+                    p_m, o_m = tr_m.params, tr_m.opt_state
+                    try:
+                        compiled = step_m.lower(
+                            p_m, o_m, di, dl, 0
+                        ).compile()
+                        peak_bytes[sched][m] = preflight_bytes(
+                            compiled.memory_analysis()
+                        )
+                    except Exception:  # noqa: BLE001 - analysis optional
+                        peak_bytes[sched][m] = None
+                    p_m, o_m, v_m = step_m(p_m, o_m, di, dl, 0)
+                    float(v_m["loss"])  # compile + sync
+                    best = float("inf")
+                    for rep in range(3):
+                        t0 = time.perf_counter()
+                        p_m, o_m, v_m = step_m(p_m, o_m, di, dl, rep + 1)
+                        float(v_m["loss"])
+                        best = min(best, time.perf_counter() - t0)
+                    times[sched][m] = best
+            measured = {
+                sched: measured_bubble_fractions(
+                    times[sched], plan.pipe_size, schedule=sched
                 )
-                step_m = tr_m._build_train_step()
-                di = tr_m._global_batch(
-                    tr_m._split_micro(sweep_in), leading_accum=True
-                )
-                dl = tr_m._global_batch(
-                    tr_m._split_micro(sweep_lab), leading_accum=True
-                )
-                p_m, o_m = tr_m.params, tr_m.opt_state
-                p_m, o_m, v_m = step_m(p_m, o_m, di, dl, 0)
-                float(v_m["loss"])  # compile + sync
-                best = float("inf")
-                for rep in range(3):
-                    t0 = time.perf_counter()
-                    p_m, o_m, v_m = step_m(p_m, o_m, di, dl, rep + 1)
-                    float(v_m["loss"])
-                    best = min(best, time.perf_counter() - t0)
-                times[m] = best
-            measured = measured_bubble_fractions(times, plan.pipe_size)
+                for sched in PIPE_SCHEDULES
+            }
             pipe_sweep = [
                 {
                     "microbatches": m,
-                    "step_time_ms": round(times[m] * 1e3, 1),
-                    "bubble_measured": round(measured[m], 4),
+                    "schedule": sched,
+                    "step_time_ms": round(times[sched][m] * 1e3, 1),
+                    "bubble_measured": round(measured[sched][m], 4),
                     "bubble_modeled": round(
-                        modeled_bubble_fraction(plan.pipe_size, m), 4
+                        modeled_bubble_fraction(
+                            plan.pipe_size, m, schedule=sched
+                        ), 4
                     ),
+                    "compiled_peak_bytes": peak_bytes[sched][m],
                 }
                 for m in sweep_ms
+                for sched in PIPE_SCHEDULES
             ]
 
     # observability twins of the --metrics_port surface: step-time
@@ -1962,8 +2005,12 @@ def main() -> None:
                 "mesh_axes": plan.describe(),
                 "mesh_unused_devices": plan.unused_devices,
                 "pipe_stages": plan.pipe_size,
+                "pipe_schedule": (
+                    trainer.pipe_schedule if plan.pipe_size > 1 else None
+                ),
                 "pipe_bubble_fraction": round(_modeled_bubble(
-                    plan.pipe_size, trainer.batch_split), 4),
+                    plan.pipe_size, trainer.batch_split,
+                    schedule=trainer.pipe_schedule), 4),
                 "pipe_bubble_sweep": pipe_sweep,
                 "hbm_preflight": trainer.preflight_report,
                 # optimizer-state layout + measured per-chip residency

@@ -368,6 +368,12 @@ def check_tracer_leak(ctx: Context) -> Iterable[Finding]:
 # -- MLA009 hand-rolled-sharding ---------------------------------------------
 
 _SHARDING_CTORS = {"NamedSharding", "PartitionSpec"}
+# stage-spec constructors (ISSUE-19): spec-building entry points that live
+# in parallel/pipeline.py; consumers outside parallel/ must go through the
+# plan's derivation (`plan.stage_specs(params)`) — importing or calling
+# these directly is the same hand-wired-layout failure mode as a bare
+# PartitionSpec
+_STAGE_SPEC_CTORS = {"stage_param_specs"}
 _MLA009_EXEMPT_PREFIX = "ml_recipe_tpu/parallel/"
 
 
@@ -433,12 +439,38 @@ def check_hand_rolled_sharding(ctx: Context) -> Iterable[Finding]:
             continue
         local = _sharding_ctor_names(src)
         for node in ast.walk(src.tree):
+            if isinstance(node, ast.ImportFrom) and node.module and (
+                node.module == "parallel.pipeline"
+                or node.module.endswith(".parallel.pipeline")
+                or node.module == "pipeline"
+            ):
+                # stage-spec construction stays inside parallel/: the
+                # sanctioned consumer spelling is plan.stage_specs(params)
+                for a in node.names:
+                    if a.name in _STAGE_SPEC_CTORS:
+                        yield rule.finding(
+                            src, node,
+                            f"`{a.name}` imported from parallel.pipeline "
+                            f"outside parallel/ — stage-spec construction "
+                            f"stays inside parallel/; derive the stage "
+                            f"layout from the ParallelPlan "
+                            f"(`plan.stage_specs(params)`)",
+                        )
+                continue
             if not isinstance(node, ast.Call):
                 continue
             d = A.dotted(node.func)
             if d is None:
                 continue
             terminal = d.rsplit(".", 1)[-1]
+            if terminal in _STAGE_SPEC_CTORS and d != "plan." + terminal:
+                yield rule.finding(
+                    src, node,
+                    f"`{d}(...)` builds stage-local specs outside "
+                    f"parallel/ — use the plan's derivation "
+                    f"(`plan.stage_specs(params)`) instead",
+                )
+                continue
             if d in local or (
                 terminal in _SHARDING_CTORS
                 and (d == terminal or d.endswith("sharding." + terminal)

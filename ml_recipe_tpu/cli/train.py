@@ -305,6 +305,8 @@ def _run_instrumented(params, model_params, watchdog, local_logger, plan,
         seed=params.seed if params.seed is not None else 0,
         optimizer_sharding=getattr(params, "optimizer_sharding", None),
         shard_optimizer=getattr(params, "shard_optimizer", False),
+        pipe_schedule=getattr(params, "pipe_schedule", "gpipe"),
+        pipe_param_sharding=getattr(params, "pipe_param_sharding", "auto"),
         zero1_overlap=getattr(params, "zero1_overlap", "off"),
         zero1_bucket_mb=getattr(params, "zero1_bucket_mb", 4.0),
         async_checkpoint=getattr(params, "async_checkpoint", False),

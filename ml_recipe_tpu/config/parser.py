@@ -455,6 +455,29 @@ def get_trainer_parser() -> ConfigArgumentParser:
                              "XLA all-gathers the sharded updates). The "
                              "reference replicates optimizer state per "
                              "process.")
+    parser.add_argument("--pipe_schedule", type=cast2(str), default="gpipe",
+                        choices=["gpipe", "1f1b"],
+                        help="Pipeline tick schedule when --mesh has a pipe "
+                             "axis > 1: 'gpipe' (default) keeps all "
+                             "batch_split micro-batch activations resident "
+                             "through the forward sweep; '1f1b' interleaves "
+                             "one-forward-one-backward so at most "
+                             "min(batch_split, 2K-1) stage inputs stay "
+                             "resident. Gradients accumulate exactly as the "
+                             "sequential scan (same trajectory within "
+                             "pipeline tolerance); inert without a pipe "
+                             "axis.")
+    parser.add_argument("--pipe_param_sharding", type=cast2(str),
+                        default="auto",
+                        choices=["auto", "stage", "replicated"],
+                        help="Pipeline parameter/optimizer storage: 'stage' "
+                             "keeps each pipe rank holding ONLY its own "
+                             "stage's trunk weights and moments (~1/K "
+                             "per-chip bytes; islands all-gather slices "
+                             "per tick), 'replicated' keeps the PR-15 "
+                             "every-rank-holds-everything layout, 'auto' "
+                             "(default) picks 'stage' whenever the pipe "
+                             "axis is > 1 on a multi-device mesh.")
     parser.add_argument("--zero1_overlap", type=cast2(str), default="off",
                         choices=["off", "bucketed"],
                         help="ZeRO-1 collective overlap: 'bucketed' splits "

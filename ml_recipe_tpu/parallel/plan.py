@@ -135,6 +135,28 @@ class ParallelPlan:
             for name, size in zip(self.mesh.axis_names, self.mesh.devices.shape)
         }
 
+    def stage_map(self, num_layers: int) -> Dict[str, str]:
+        """``{"stage_k": "layer_lo..layer_hi"}`` — which contiguous encoder
+        layers each pipe rank owns (pre-flight report / bench JSON). Empty
+        when the plan has no multi-way pipe axis."""
+        if self.pipe_size <= 1:
+            return {}
+        from .pipeline import stage_assignment
+
+        return {
+            f"stage_{k}": f"layer_{lo}..layer_{hi - 1}"
+            for k, (lo, hi) in stage_assignment(
+                int(num_layers), self.pipe_size
+            ).items()
+        }
+
+    def stage_specs(self, params):
+        """Stage-local param PartitionSpec tree (trunk leaves over
+        ``pipe``, TP dims honored) — see ``pipeline.stage_param_specs``."""
+        from .pipeline import stage_param_specs
+
+        return stage_param_specs(params, self)
+
     # -- derived shardings ---------------------------------------------------
 
     def named(self, spec: P) -> NamedSharding:
@@ -160,10 +182,14 @@ class ParallelPlan:
     def param_specs(self, params):
         return param_pspecs(params, self.mesh)
 
-    def zero1(self, tree, *, min_size: int = 16384):
+    def zero1(self, tree, *, min_size: int = 16384,
+              stage_pipe: bool = False):
         """The padding-aware per-leaf ZeRO-1 placement plan (over the
-        ``data`` axis; TP axes honored) — see ``sharding.zero1_plan``."""
-        return zero1_plan(tree, self.mesh, min_size=min_size)
+        ``data`` axis; TP axes honored; with ``stage_pipe`` the ``pipe``
+        axis claims its stage-scope dim first, so the data-axis plan runs
+        within each stage's leaf set) — see ``sharding.zero1_plan``."""
+        return zero1_plan(tree, self.mesh, min_size=min_size,
+                          stage_pipe=stage_pipe)
 
     def zero1_param_shardings(self, zplan):
         """NamedSharding tree for a ZeRO-1 leaf-plan tree (the layout the
@@ -174,12 +200,16 @@ class ParallelPlan:
         )
 
     def opt_state_shardings(self, state_shapes, *,
-                            zero1: bool, min_size: int = 16384):
+                            zero1: bool, min_size: int = 16384,
+                            stage_pipe: bool = False):
         """NamedSharding tree for an optimizer-state (shape) tree:
         ZeRO-1 layout when ``zero1`` (each shardable leaf over ``data``),
-        otherwise the replicated-with-TP-rules layout. ONE derivation for
-        the trainer's ``init_opt_state``, the checkpoint reconciliation
-        and the layout-consistency tests."""
+        otherwise the replicated-with-TP-rules layout; ``stage_pipe``
+        additionally lands each stage-scope leaf's moments on the
+        ``pipe`` axis (stage-local optimizer state — independent of the
+        min_size gate, which only governs the data axis). ONE derivation
+        for the trainer's ``init_opt_state``, the checkpoint
+        reconciliation and the layout-consistency tests."""
         return jax.tree_util.tree_map(
             lambda spec: self.named(spec),
             zero_pspecs(
@@ -187,6 +217,7 @@ class ParallelPlan:
                 # min_size=inf disables the data axis: TP rules still
                 # apply, everything else replicates (the non-ZeRO layout)
                 min_size=min_size if zero1 else math.inf,
+                stage_pipe=stage_pipe,
             ),
         )
 

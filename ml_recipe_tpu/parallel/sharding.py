@@ -86,11 +86,25 @@ class ZeroLeafPlan(NamedTuple):
     padded: Optional[int]
 
 
+# Param paths eligible for stage-local ``pipe``-axis sharding: exactly the
+# leaves a pipeline stage consumes exclusively (the embedding table feeds
+# only rank 0's refill; each encoder layer runs on exactly one stage).
+# Pooler/head leaves run outside (or on the last tick of) the island on
+# every rank's collected outputs, so they stay replicated — they are a
+# rounding error of bert-large's bytes next to the layer stack.
+STAGE_SCOPE_RE = re.compile(r"(^|/)transformer/(embeddings|layer_\d+)(/|$)")
+
+
 def _zero_leaf_plan(path, shape, *, data_size: int,
-                    has_tp: bool, min_size) -> ZeroLeafPlan:
+                    has_tp: bool, min_size,
+                    pipe_size: int = 1) -> ZeroLeafPlan:
     """The ONE dim chooser every ZeRO-1 consumer shares (state shardings,
     gradient constraints, byte modeling, checkpoint reconciliation):
-    tensor-parallel axes are honored first; the ``data`` axis then lands on
+    tensor-parallel axes are honored first; with ``pipe_size > 1`` the
+    ``pipe`` axis then claims the largest stage-scope dim divisible by the
+    stage count (stage-local param/optimizer storage — no padding: encoder
+    dims are powers of two in practice, and a leaf with no dividing dim
+    simply stays pipe-replicated); the ``data`` axis finally lands on
     the largest remaining dim already divisible by the axis size — or, when
     none divides, on the largest remaining dim PADDED up to the next
     multiple (this JAX rejects uneven shardings, so divisibility is bought
@@ -98,12 +112,20 @@ def _zero_leaf_plan(path, shape, *, data_size: int,
     ``min_size`` elements (and scalars) stay replicated: sharding them buys
     nothing and costs collective latency."""
     axes = [None] * len(shape)
+    path_s = _path_str(path)
     if has_tp:
-        path_s = _path_str(path)
         for pattern, spec in TP_RULES:
             if re.match(pattern, path_s):
                 axes = list(spec) + [None] * (len(shape) - len(spec))
                 break
+    if pipe_size > 1 and STAGE_SCOPE_RE.search(path_s):
+        pipe_free = [
+            (dim, i) for i, dim in enumerate(shape)
+            if axes[i] is None and dim % pipe_size == 0
+        ]
+        if pipe_free:
+            _, i = max(pipe_free)
+            axes[i] = PIPE_AXIS
     if data_size <= 1 or int(np.prod(shape or (0,))) < min_size:
         return ZeroLeafPlan(P(*axes), None, None)
     free = [(dim, i) for i, dim in enumerate(shape) if axes[i] is None]
@@ -120,27 +142,34 @@ def _zero_leaf_plan(path, shape, *, data_size: int,
     return ZeroLeafPlan(P(*axes), i, padded)
 
 
-def zero1_plan(tree, mesh: Mesh, *, min_size: int = 16384):
+def zero1_plan(tree, mesh: Mesh, *, min_size: int = 16384,
+               stage_pipe: bool = False):
     """ZeRO-1 placement plan for a (shape-carrying) pytree: one
     :class:`ZeroLeafPlan` per leaf. Works on live arrays and on
     ``jax.eval_shape`` outputs alike — only ``.shape`` is read. Leaf paths
     inside optax states end with the param path (e.g.
     ``.../mu/encoder/layer_0/attention/query/kernel``), so the tensor-
-    parallel rules apply unchanged."""
+    parallel rules apply unchanged. With ``stage_pipe`` the ``pipe`` axis
+    claims its stage-scope dim first, so the data-axis padded-leaf plan
+    runs WITHIN a stage's leaf set (ZeRO-1 under pipeline)."""
     data_size = int(mesh.shape.get(DATA_AXIS, 1))
     has_tp = MODEL_AXIS in mesh.axis_names and mesh.shape[MODEL_AXIS] > 1
+    pipe_size = (
+        int(mesh.shape.get(PIPE_AXIS, 1)) if stage_pipe else 1
+    )
 
     def plan_for(path, leaf):
         shape = tuple(getattr(leaf, "shape", ()))
         return _zero_leaf_plan(
             path, shape, data_size=data_size, has_tp=has_tp,
-            min_size=min_size,
+            min_size=min_size, pipe_size=pipe_size,
         )
 
     return jax.tree_util.tree_map_with_path(plan_for, tree)
 
 
-def zero_pspecs(state_shapes, mesh: Mesh, *, min_size: int = 16384):
+def zero_pspecs(state_shapes, mesh: Mesh, *, min_size: int = 16384,
+                stage_pipe: bool = False):
     """ZeRO-1 PartitionSpec tree for an optimizer-state (shape) tree.
 
     The reference replicates optimizer state on every replica (SURVEY.md
@@ -151,7 +180,9 @@ def zero_pspecs(state_shapes, mesh: Mesh, *, min_size: int = 16384):
     their PADDED extents (``zero_pad_tree``) where the plan demands padding.
     """
     return jax.tree_util.tree_map(
-        lambda z: z.spec, zero1_plan(state_shapes, mesh, min_size=min_size),
+        lambda z: z.spec,
+        zero1_plan(state_shapes, mesh, min_size=min_size,
+                   stage_pipe=stage_pipe),
         is_leaf=lambda x: isinstance(x, ZeroLeafPlan),
     )
 
@@ -215,32 +246,41 @@ def opt_state_bytes_per_chip(opt_state) -> int:
 
 
 def zero1_state_bytes(state_shapes, *, data_size: int,
-                      min_size: int = 16384) -> dict:
+                      min_size: int = 16384,
+                      pipe_size: int = 1) -> dict:
     """MODELED optimizer-state bytes per chip at an arbitrary data-axis
     size — no mesh, no devices, no compile: the HBM-planning probe
     (``bench.py --param_count_probe``) runs this before a TPU window opens.
 
     Returns ``replicated_bytes`` (every leaf in full — the historical
     layout), ``zero1_bytes`` (each plan-sharded leaf at its padded extent
-    divided over ``data_size``, the rest in full) and ``sharded_bytes``
-    (the replicated footprint of exactly the leaves the plan shards — the
-    ``(N-1)/N`` savings base the acceptance math is stated against).
+    divided over ``data_size`` — and, with ``pipe_size > 1``, each
+    stage-scope leaf further divided over its ``pipe`` dim — the rest in
+    full) and ``sharded_bytes`` (the replicated footprint of exactly the
+    leaves the plan shards — the ``(N-1)/N`` savings base the acceptance
+    math is stated against).
     """
     data_size = max(1, int(data_size))
+    pipe_size = max(1, int(pipe_size))
 
     def leaf_info(path, leaf):
         shape = tuple(getattr(leaf, "shape", ()))
         dtype = np.dtype(getattr(leaf, "dtype", np.float32))
         z = _zero_leaf_plan(
             path, shape, data_size=data_size, has_tp=False,
-            min_size=min_size,
+            min_size=min_size, pipe_size=pipe_size,
         )
         full = int(np.prod(shape or (1,), dtype=np.int64)) * dtype.itemsize
+        shard = list(shape)
+        for i, ax in enumerate(z.spec):
+            if ax == PIPE_AXIS:
+                shard[i] = shard[i] // pipe_size
         if z.axis is None:
-            return full, full, 0
-        padded = list(shape)
-        padded[z.axis] = z.padded
-        shard = list(padded)
+            shard_bytes = (
+                int(np.prod(shard or [1], dtype=np.int64)) * dtype.itemsize
+            )
+            sharded = full if shard_bytes < full else 0
+            return full, shard_bytes, sharded
         shard[z.axis] = z.padded // data_size
         shard_bytes = int(np.prod(shard, dtype=np.int64)) * dtype.itemsize
         return full, shard_bytes, full
@@ -337,15 +377,17 @@ def split_micro(tree, n: int):
 
 def batch_pspec(mesh: Mesh, *, shard_seq: bool = False, ndim: int = 2) -> P:
     """Spec for one batch leaf: batch dim over data, optionally seq dim over
-    seq for context-parallel runs."""
+    seq for context-parallel runs. Meshes without a data axis (e.g.
+    ``pipe:2,model:2``) replicate the batch dim."""
+    data_axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
     seq_axis = (
         SEQ_AXIS
         if shard_seq and SEQ_AXIS in mesh.axis_names and mesh.shape[SEQ_AXIS] > 1
         else None
     )
     if ndim == 1:
-        return P(DATA_AXIS)
-    return P(DATA_AXIS, *([seq_axis] + [None] * (ndim - 2)))
+        return P(data_axis)
+    return P(data_axis, *([seq_axis] + [None] * (ndim - 2)))
 
 
 def batch_sharding(mesh: Mesh, batch_tree, *, shard_seq: bool = False):
@@ -377,7 +419,8 @@ def make_global_array(
             spec = batch_pspec(mesh, shard_seq=shard_seq, ndim=x.ndim)
         else:
             axes = [None] * x.ndim
-            axes[batch_axis] = DATA_AXIS
+            if DATA_AXIS in mesh.axis_names:
+                axes[batch_axis] = DATA_AXIS
             spec = P(*axes)
         sharding = NamedSharding(mesh, spec)
         if jax.process_count() == 1:

@@ -366,6 +366,14 @@ def peek_checkpoint_layout(path) -> Optional[dict]:
                 # recorded one — the topology this checkpoint was written
                 # under; restores reshard onto any live plan regardless
                 "mesh_axes": (manifest.get("extra") or {}).get("mesh_axes"),
+                # pipeline saves stamp the tick schedule and whether trunk
+                # params were stored stage-local; None for non-pipe savers
+                "pipe_schedule": (manifest.get("extra") or {}).get(
+                    "pipe_schedule"
+                ),
+                "pipe_param_layout": (manifest.get("extra") or {}).get(
+                    "pipe_param_layout"
+                ),
                 "groups": {g: len(leaves) for g, leaves in groups.items()},
             }
         with open(path, "rb") as fh:
@@ -377,6 +385,8 @@ def peek_checkpoint_layout(path) -> Optional[dict]:
             "shards": 1,
             "opt_sharding": state.get("opt_sharding"),
             "mesh_axes": state.get("mesh_axes"),
+            "pipe_schedule": state.get("pipe_schedule"),
+            "pipe_param_layout": state.get("pipe_param_layout"),
             "groups": {
                 g: len(flatten_dict(state[g], keep_empty_nodes=True))
                 for g in ("model", "optimizer", "loss_scale")

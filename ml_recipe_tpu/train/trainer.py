@@ -213,6 +213,17 @@ class Trainer:
     shard_optimizer: bool = False
     zero_min_size: int = 16384      # leaves smaller than this stay replicated
 
+    # Pipeline schedule + stage-local state (--pipe_schedule, pipe axis >1
+    # only): 'gpipe' runs the PR-15 all-m-resident schedule, '1f1b' the
+    # one-forward-one-backward tick program that caps resident activations
+    # at the in-flight window (parallel/pipeline.py). With
+    # `pipe_param_sharding` (default on when the pipe axis is >1 on a
+    # multi-device mesh) each rank STORES only its stage's slice of the
+    # trunk params and optimizer state (~1/K per-chip bytes); the islands
+    # all-gather the slices explicitly per tick.
+    pipe_schedule: str = "gpipe"
+    pipe_param_sharding: Any = None
+
     # Bucketed ZeRO-1 collective overlap (--zero1_overlap off|bucketed):
     # 'off' (default) keeps the monolithic flat-vector gradient exchange
     # bit-exactly; 'bucketed' splits the flat f32 accumulation carry into
@@ -340,21 +351,47 @@ class Trainer:
         # pre-flight topology records — derives from this ONE object.
         self.plan = ParallelPlan.from_mesh(self.mesh)
         self.pipe_stages = self.plan.pipe_size
+        self.pipe_schedule = str(self.pipe_schedule or "gpipe").lower()
+        pps = self.pipe_param_sharding
+        if isinstance(pps, str):
+            pps = {"stage": True, "on": True, "replicated": False,
+                   "off": False, "auto": None}.get(pps.lower(), pps)
+            if isinstance(pps, str):
+                raise ValueError(
+                    f"--pipe_param_sharding must be one of "
+                    f"auto|stage|replicated, got {self.pipe_param_sharding!r}"
+                )
+        if pps is None:
+            # stage-local storage is the default whenever it can shard:
+            # a pipe axis on a one-device mesh has nothing to split
+            pps = self.pipe_stages > 1 and not self.plan.single_device
+        self.pipe_param_sharding = bool(pps)
         if self.pipe_stages > 1:
-            from ..parallel.pipeline import validate_pipeline_plan
+            from ..parallel.pipeline import (
+                modeled_bubble_fraction, validate_pipeline_plan,
+            )
 
             validate_pipeline_plan(
-                self.plan, self.model, batch_split=self.batch_split
+                self.plan, self.model, batch_split=self.batch_split,
+                schedule=self.pipe_schedule,
             )
             logger.info(
                 "Pipeline parallelism: %d stages x %d layers over the "
-                "pipe axis, GPipe schedule over %d micro-batch(es) "
-                "(modeled bubble %.1f%%).",
+                "pipe axis, %s schedule over %d micro-batch(es) "
+                "(modeled bubble %.1f%%, stage-local params %s).",
                 self.pipe_stages,
                 int(self.model.cfg.num_layers) // self.pipe_stages,
+                self.pipe_schedule,
                 self.batch_split,
-                100.0 * (self.pipe_stages - 1)
-                / (self.pipe_stages - 1 + self.batch_split),
+                100.0 * modeled_bubble_fraction(
+                    self.pipe_stages, self.batch_split, self.pipe_schedule
+                ),
+                "on" if self.pipe_param_sharding else "off",
+            )
+        elif self.pipe_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"--pipe_schedule must be 'gpipe' or '1f1b', got "
+                f"{self.pipe_schedule!r}"
             )
 
         self.process_index = jax.process_index()
@@ -506,7 +543,18 @@ class Trainer:
         # shard_params skips NamedSharding commitment on single-device meshes
         # (GSPMD-partitioned compile path: measured 200x slowdown on the
         # tunneled single-chip backend, and it buys nothing without peers).
-        self.params = shard_params(self.params, self.mesh)
+        # Under stage-local pipeline storage the trunk leaves land
+        # pipe-sharded (parallel/pipeline.stage_param_specs) instead of
+        # replicated — ~1/K per-chip param bytes.
+        self._stage_param_specs = None
+        if self.pipe_param_sharding and self.pipe_stages > 1 \
+                and not is_single_device(self.mesh):
+            # the plan's derivation (MLA009: stage-spec construction
+            # stays inside parallel/)
+            self._stage_param_specs = self.plan.stage_specs(self.params)
+        self.params = shard_params(
+            self.params, self.mesh, pspecs=self._stage_param_specs
+        )
         self._param_shardings = (
             None
             if is_single_device(self.mesh)
@@ -522,6 +570,7 @@ class Trainer:
         self._zero_shardings = None
         self._zero_plan = None
         self._zero_param_shardings = None
+        self._opt_state_shardings = None
         self._use_loss_scale = False
         if self.train_dataloader is not None and self.trainer_params is not None:
             micro_batch = self.train_batch_size // self.batch_split
@@ -654,16 +703,21 @@ class Trainer:
         ``count`` included) would land committed to the default device.
         """
         use_zero = self.zero_enabled()
+        stage_pipe = bool(self._stage_param_specs is not None)
         if is_single_device(self.mesh):
             self._zero_shardings = None
             self._zero_plan = None
             self._zero_param_shardings = None
+            self._opt_state_shardings = None
             self.opt_state = jax.jit(self.optimizer.init)(self.params)
             self._bundle_ls()
             return
 
         if use_zero:
-            zplan = self.plan.zero1(self.params, min_size=self.zero_min_size)
+            zplan = self.plan.zero1(
+                self.params, min_size=self.zero_min_size,
+                stage_pipe=stage_pipe,
+            )
             self._zero_plan = zplan
             self._zero_param_shardings = self.plan.zero1_param_shardings(
                 zplan
@@ -676,12 +730,15 @@ class Trainer:
 
         state_shapes = jax.eval_shape(init_fn, self.params)
         # the one derivation of the optimizer-state layout (ZeRO-1 over
-        # the plan's data axis, or replicated-with-TP-rules) — shared with
-        # the layout-consistency tests and checkpoint reconciliation
+        # the plan's data axis, stage-local over pipe, or replicated-
+        # with-TP-rules) — shared with the layout-consistency tests and
+        # checkpoint reconciliation
         shardings = self.plan.opt_state_shardings(
-            state_shapes, zero1=use_zero, min_size=self.zero_min_size
+            state_shapes, zero1=use_zero, min_size=self.zero_min_size,
+            stage_pipe=stage_pipe,
         )
         self._zero_shardings = shardings if use_zero else None
+        self._opt_state_shardings = shardings
         self.opt_state = jax.jit(
             init_fn, out_shardings=shardings
         )(self.params)
@@ -691,6 +748,11 @@ class Trainer:
                 "(%.1f MB per chip).",
                 int(self.mesh.shape.get("data", 1)),
                 opt_state_bytes_per_chip(self.opt_state) / 1e6,
+            )
+        if stage_pipe:
+            logger.info(
+                "Stage-local state: trunk params + optimizer moments "
+                "sharded over the %d-way pipe axis.", self.pipe_stages,
             )
         self._bundle_ls()
 
@@ -862,6 +924,40 @@ class Trainer:
             split *= 2
         return None
 
+    def _preflight_pipe_fields(self) -> dict:
+        """The pipeline-aware slice of both pre-flight reports:
+        per-chip PARAM residency (which drops ~1/K under stage-local
+        storage — the planner must see the real number, not the
+        replicated fiction), the schedule, and the stage -> layer / bytes
+        map (so the report can tell you which layers rank 2 owns)."""
+        fields = {
+            "param_bytes": (
+                opt_state_bytes_per_chip(self.params)
+                if self.params is not None else None
+            ),
+            "pipe_schedule": (
+                self.pipe_schedule if self.pipe_stages > 1 else None
+            ),
+            "pipe_param_layout": (
+                ("stage" if self._stage_param_specs is not None
+                 else "replicated")
+                if self.pipe_stages > 1 else None
+            ),
+            "pipe_stage_layers": None,
+            "pipe_stage_param_bytes": None,
+        }
+        if self.pipe_stages > 1:
+            from ..parallel.pipeline import stage_param_bytes
+
+            fields["pipe_stage_layers"] = self.plan.stage_map(
+                int(self.model.cfg.num_layers)
+            )
+            fields["pipe_stage_param_bytes"] = stage_param_bytes(
+                self.params, pipe_size=self.pipe_stages,
+                model_size=self.plan.model_size,
+            )["per_stage_bytes"]
+        return fields
+
     def preflight_train_step(self, host_inputs, host_labels, *,
                              compile_fn=None, limit_bytes=None):
         """HBM pre-flight: lower + compile the jitted train step once at the
@@ -910,6 +1006,7 @@ class Trainer:
                 if self.opt_state is not None
                 else None
             ),
+            **self._preflight_pipe_fields(),
         }
         while True:
             if self._jit_train_step is None:
@@ -1020,6 +1117,7 @@ class Trainer:
                 if self.opt_state is not None
                 else None
             ),
+            **self._preflight_pipe_fields(),
         }
         while True:
             if self._jit_train_step is None:
@@ -1133,10 +1231,17 @@ class Trainer:
         cfg = getattr(self.model, "cfg", None)
         if cfg is None:
             return "anon"
-        return (
+        sig = (
             f"h{cfg.hidden_size}l{cfg.num_layers}n{cfg.num_heads}"
             f"v{cfg.vocab_size}"
         )
+        if self.pipe_stages > 1:
+            # gpipe and 1f1b compile DIFFERENT programs over identical
+            # shapes + shardings — a schedule flip must never deserialize
+            # the other schedule's executable
+            layout = "s" if self._stage_param_specs is not None else "r"
+            sig += f"-{self.pipe_schedule}{layout}"
+        return sig
 
     def _aot_train_step_program(self, dev_inputs, dev_labels):
         """The train-step executable for these PLACED batches, through the
@@ -1189,6 +1294,10 @@ class Trainer:
         zero_param_shardings = self._zero_param_shardings
         zero_state_shardings = self._zero_shardings
         param_shardings = self._param_shardings
+        # stage-local pipeline storage: grads/params/opt-state live
+        # pipe-sharded; the update must keep (not silently undo) that layout
+        stage_mode = self._stage_param_specs is not None
+        opt_state_shardings = self._opt_state_shardings
         # the optimizer chain is built without clip_by_global_norm — the step
         # clips the flat gradient vector itself whenever max_grad_norm is set
         clip_norm = self.max_grad_norm
@@ -1205,12 +1314,14 @@ class Trainer:
         )
         # The flat f32 gradient carry is replicated; on a pure data-parallel
         # mesh grads are replicated anyway so it only fuses launches, but on
-        # a model(TP)-axis mesh it would all-gather every sharded gradient
-        # each micro-batch — use sharding-preserving per-tensor accumulation
-        # there instead.
+        # a model(TP)-axis mesh — or under stage-local pipeline storage,
+        # where grads leave the island pipe-sharded — it would all-gather
+        # every sharded gradient each micro-batch; use sharding-preserving
+        # per-tensor accumulation there instead.
         use_flat = (
             is_single_device(self.mesh)
-            or int(self.mesh.shape.get("model", 1)) <= 1
+            or (int(self.mesh.shape.get("model", 1)) <= 1
+                and self._stage_param_specs is None)
         )
 
         # Bucketed ZeRO-1 collective overlap: the single flat carry makes
@@ -1502,13 +1613,20 @@ class Trainer:
                 updates, new_opt_state = optimizer.update(
                     grads, opt_state, params
                 )
+                if stage_mode and opt_state_shardings is not None:
+                    # keep the stage-local moments pipe-sharded across
+                    # steps (same discipline as the ZeRO constraint above)
+                    new_opt_state = jax.lax.with_sharding_constraint(
+                        new_opt_state, opt_state_shardings
+                    )
             new_params = jax.tree_util.tree_map(
                 lambda p, u: (p + u).astype(p.dtype), params, updates
             )
-            if zero_plan is not None and param_shardings is not None:
-                # the forward consumes replicated params — pin the
-                # all-gathered result to the params' own (replicated or TP)
-                # layout so the donated buffers keep their shape
+            if (zero_plan is not None or stage_mode) \
+                    and param_shardings is not None:
+                # pin the updated params to the params' own (replicated,
+                # TP, or stage-local) layout so the donated buffers keep
+                # their shape
                 new_params = jax.lax.with_sharding_constraint(
                     new_params, param_shardings
                 )
@@ -1605,11 +1723,14 @@ class Trainer:
             from ..parallel.pipeline import (
                 apply_qa_heads,
                 make_pipeline_encoder,
+                make_pipeline_train_step,
             )
 
+            stage_specs = self._stage_param_specs
             pipe_encode = make_pipeline_encoder(
                 model_obj, plan, batch_split=batch_split,
                 deterministic=False, prng_impl=self.prng_impl,
+                stage_specs=stage_specs,
             )
             num_layers = int(model_obj.cfg.num_layers)
 
@@ -1672,6 +1793,43 @@ class Trainer:
                     params, opt_state, acc_grads, values, step, ls_state,
                     ops,
                 )
+
+            if self.pipe_schedule == "1f1b":
+                # 1F1B body: forward, heads, loss AND backward run inside
+                # one manual-VJP island (parallel/pipeline.py) whose grads
+                # are proven equal to the sequential scan's — so the same
+                # finish_step pins the update arithmetic. Activation
+                # residency is capped at the in-flight window instead of
+                # all batch_split micro-batches.
+                pipe_run = make_pipeline_train_step(
+                    model_obj, loss, plan, batch_split=batch_split,
+                    prng_impl=self.prng_impl, stage_specs=stage_specs,
+                )
+
+                def train_step_pipe(params, opt_state, inputs, labels,
+                                    step):
+                    ls_state = None
+                    if use_ls:
+                        opt_state, ls_state = opt_state.inner, opt_state.ls
+                    ops = grad_ops(params)
+                    base = jax.random.fold_in(
+                        jax.random.key(self.seed, impl=self.prng_impl),
+                        step,
+                    )
+                    scale = (
+                        ls_state.scale if use_ls else jnp.float32(1.0)
+                    )
+                    grads, values = pipe_run(
+                        params, inputs, labels, base, scale
+                    )
+                    values = jax.tree_util.tree_map(
+                        lambda v: v * inv, values
+                    )
+                    acc_grads = ops.acc_from_tree(grads)
+                    return finish_step(
+                        params, opt_state, acc_grads, values, step,
+                        ls_state, ops,
+                    )
 
         return jax.jit(
             train_step_pipe if pipe else train_step, donate_argnums=(0, 1)
@@ -2286,10 +2444,21 @@ class Trainer:
         """Topology record every checkpoint carries: the actual optimizer
         layout and the plan's mesh axes — so ``peek_checkpoint_layout``
         can report what topology wrote a checkpoint (restores stay
-        shape-driven and reshard onto any live plan)."""
+        shape-driven and reshard onto any live plan). Pipeline runs
+        additionally stamp the tick schedule and whether the trunk was
+        stored stage-local (``stage``) or replicated per rank — purely
+        informational for the peek: both restore paths are shape-driven,
+        so a stage-sharded save at ``pipe:K`` restores at ``pipe:K'``,
+        under no pipe axis at all, or under the other schedule."""
+        pipe = self.pipe_stages > 1
         return {
             "opt_sharding": self.effective_opt_sharding,
             "mesh_axes": self.plan.describe(),
+            "pipe_schedule": self.pipe_schedule if pipe else None,
+            "pipe_param_layout": (
+                ("stage" if self._stage_param_specs is not None
+                 else "replicated") if pipe else None
+            ),
         }
 
     def save_state_dict(self, path_):

@@ -16,3 +16,9 @@ def replicate(tree, plan):
 def opt_layout(plan, state_shapes, min_size):
     return plan.opt_state_shardings(state_shapes, zero1=True,
                                     min_size=min_size)
+
+
+def stage_layout(params, plan):
+    # stage-local pipeline layout: also derived from the plan, never
+    # constructed here (ISSUE-19)
+    return plan.stage_specs(params)

@@ -15,3 +15,11 @@ def replicate(tree, mesh):
     import jax.sharding as jsh
 
     return jax.device_put(tree, jsh.NamedSharding(mesh, jsh.PartitionSpec()))
+
+
+def stage_layout(params, plan):
+    # stage-spec construction outside parallel/ fires too (ISSUE-19):
+    # both the import and the call spelling
+    from ml_recipe_tpu.parallel.pipeline import stage_param_specs
+
+    return stage_param_specs(params, plan)

@@ -96,6 +96,39 @@ def test_every_rule_has_fixture_pair():
 
 # -- targeted rule semantics -------------------------------------------------
 
+def test_mla009_stage_spec_scope(tmp_path):
+    """ISSUE-19: stage-spec construction (parallel/pipeline's
+    ``stage_param_specs``) joins MLA009's scope — importing or calling it
+    outside parallel/ fires (the sanctioned spelling is
+    ``plan.stage_specs(params)``), while parallel/ itself stays exempt
+    with NO new allowlist entries."""
+    inside = tmp_path / "ml_recipe_tpu" / "parallel" / "helper.py"
+    inside.parent.mkdir(parents=True)
+    inside.write_text(
+        "from .pipeline import stage_param_specs\n"
+        "def derive(params, plan):\n"
+        "    return stage_param_specs(params, plan)\n"
+    )
+    outside = tmp_path / "ml_recipe_tpu" / "train" / "layouts.py"
+    outside.parent.mkdir(parents=True)
+    outside.write_text(
+        "from ml_recipe_tpu.parallel.pipeline import stage_param_specs\n"
+        "def derive(params, plan):\n"
+        "    return stage_param_specs(params, plan)\n"
+    )
+    sanctioned = tmp_path / "ml_recipe_tpu" / "train" / "ok.py"
+    sanctioned.write_text(
+        "def derive(params, plan):\n"
+        "    return plan.stage_specs(params)\n"
+    )
+    report = run_analysis(paths=[tmp_path / "ml_recipe_tpu"],
+                          rules=["MLA009"], allowlist=[], root=tmp_path)
+    hit_paths = {f.path for f in report.findings}
+    assert hit_paths == {"ml_recipe_tpu/train/layouts.py"}, hit_paths
+    # both the import and the call site fire
+    assert len(report.findings) == 2
+
+
 def test_mla004_follows_package_imports(tmp_path):
     """The lockstep rule chases intra-package imports: a helper pulled in
     by packing.py is held to the same seeded-Generator discipline."""

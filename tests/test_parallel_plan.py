@@ -120,14 +120,19 @@ def test_plan_layouts_single_source_of_truth(tmp_path, mesh_spec):
     )
 
     # (a) the live optimizer state's shardings == the plan's derivation
+    # (stage_pipe mirrors the trainer: pipe-bearing meshes default to
+    # stage-local trunk storage, ISSUE-19)
     from ml_recipe_tpu.parallel.sharding import zero_pad_tree
 
-    zplan = plan.zero1(trainer.params, min_size=0)
+    stage_pipe = trainer._stage_param_specs is not None
+    assert stage_pipe == (plan.pipe_size > 1)
+    zplan = plan.zero1(trainer.params, min_size=0, stage_pipe=stage_pipe)
     state_shapes = jax.eval_shape(
         lambda p: trainer.optimizer.init(zero_pad_tree(p, zplan)),
         trainer.params,
     )
-    want = plan.opt_state_shardings(state_shapes, zero1=True, min_size=0)
+    want = plan.opt_state_shardings(state_shapes, zero1=True, min_size=0,
+                                    stage_pipe=stage_pipe)
     got = jax.tree_util.tree_map(lambda x: x.sharding, trainer.opt_state)
     for w, g in zip(jax.tree_util.tree_leaves(want),
                     jax.tree_util.tree_leaves(got)):
@@ -151,7 +156,14 @@ def test_plan_layouts_single_source_of_truth(tmp_path, mesh_spec):
     layout = peek_checkpoint_layout(ckpt)
     assert layout["mesh_axes"] == plan.describe()
     assert layout["opt_sharding"] == "zero1"
-    assert layout["shards"] == plan.data_size
+    # widest leaf: data-axis ZeRO shards x stage-local pipe shards
+    assert layout["shards"] == plan.data_size * plan.pipe_size
+    if plan.pipe_size > 1:
+        assert layout["pipe_schedule"] == "gpipe"
+        assert layout["pipe_param_layout"] == "stage"
+    else:
+        assert layout["pipe_schedule"] is None
+        assert layout["pipe_param_layout"] is None
 
     # (d) the pre-flight report carries the plan topology + stranded count
     # (mocked memory analysis — CPU reports no real limit)
@@ -172,6 +184,20 @@ def test_plan_layouts_single_source_of_truth(tmp_path, mesh_spec):
     )
     assert report["mesh_axes"] == plan.describe()
     assert report["mesh_unused_devices"] == plan.unused_devices
+    # (e) pipe-bearing plans name the stage->layer assignment, the
+    # schedule and the per-stage param bytes (ISSUE-19 satellite)
+    assert report["param_bytes"] > 0
+    if plan.pipe_size > 1:
+        assert report["pipe_schedule"] == "gpipe"
+        assert report["pipe_param_layout"] == "stage"
+        assert report["pipe_stage_layers"] == {
+            "stage_0": "layer_0..layer_0", "stage_1": "layer_1..layer_1",
+        }
+        assert len(report["pipe_stage_param_bytes"]) == 2
+        assert all(v > 0 for v in report["pipe_stage_param_bytes"].values())
+    else:
+        assert report["pipe_schedule"] is None
+        assert report["pipe_param_layout"] is None
 
 
 # -- pipeline parity ----------------------------------------------------------
@@ -219,13 +245,18 @@ def test_validate_pipeline_plan_errors(tmp_path):
     plan3 = ParallelPlan.from_spec("data:1,pipe:3")  # 2 layers % 3 != 0
     with pytest.raises(ValueError, match="equal contiguous stages"):
         validate_pipeline_plan(plan3, t.model, batch_split=2)
-    with pytest.raises(NotImplementedError, match="seq"):
+    with pytest.raises(NotImplementedError, match="shard_map"):
         validate_pipeline_plan(
             ParallelPlan.from_spec("pipe:2,seq:2"), t.model, batch_split=2
         )
-    with pytest.raises(NotImplementedError, match="model"):
+    # pipe x model composes since ISSUE-19 (stage specs keep their TP dims)
+    validate_pipeline_plan(
+        ParallelPlan.from_spec("pipe:2,model:2"), t.model, batch_split=2
+    )
+    with pytest.raises(ValueError, match="--pipe_schedule"):
         validate_pipeline_plan(
-            ParallelPlan.from_spec("pipe:2,model:2"), t.model, batch_split=2
+            ParallelPlan.from_spec("data:1,pipe:2"), t.model,
+            batch_split=2, schedule="interleaved",
         )
     assert stage_layer_count(12, 4) == 3
 
@@ -293,6 +324,10 @@ def test_pipe_schedule_overlap_is_real():
             loss=build_loss(TP()), collate_fun=None, trainer_params=None,
             mesh=mesh, batch_split=m, seed=0, train_batch_size=B,
             hbm_preflight=False,
+            # replicated storage: this test measures SCHEDULE overlap, and
+            # stage-local storage adds a constant per-step param all-gather
+            # that flattens the tiny-model CPU timing curve
+            pipe_param_sharding="replicated",
         )
         tr.optimizer, tr.scheduler, tr._schedule_count = build_optimizer(
             TP(), tr.params, num_training_steps=100, max_grad_norm=None,

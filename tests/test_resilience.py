@@ -1317,8 +1317,11 @@ def test_manifest_records_shard_layout_and_peek(tmp_path):
         "shards": 8,
         "opt_sharding": "zero1",
         # written without a trainer: no ParallelPlan topology was recorded
-        # (trainer saves stamp plan.describe() here — ISSUE-15)
+        # (trainer saves stamp plan.describe() here — ISSUE-15), nor a
+        # pipeline schedule/layout (ISSUE-19: trainer pipe saves stamp both)
         "mesh_axes": None,
+        "pipe_schedule": None,
+        "pipe_param_layout": None,
         "groups": {"model": 1, "optimizer": 2},
     }
     assert peek_checkpoint_layout(tmp_path / "absent.ch") is None
@@ -1448,6 +1451,129 @@ def test_zero1_checkpoint_survives_mesh_reshape(tmp_path):
     )
     # and back to a replicated layout on a wider mesh
     assert "RESUMED_OK mesh=data:8 mode=off" in phase("data:8", "off")
+
+
+_PIPE_STAGE_RESHAPE_TRAIN = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, sys.argv[5])                    # tests/ (conftest)
+    sys.path.insert(0, os.path.dirname(sys.argv[5]))   # repo root
+    import conftest  # 8-device CPU mesh + autotune cache isolation
+    import pathlib
+    import numpy as np
+    import jax
+
+    from test_trainer import _make_trainer
+    from ml_recipe_tpu.parallel.sharding import gather_to_host
+
+    work = pathlib.Path(sys.argv[1]); mesh_spec = sys.argv[2]
+    schedule = sys.argv[3]; out_tag = sys.argv[4]
+    tag = mesh_spec.replace(":", "_").replace(",", "__") + "_" + out_tag
+    (work / tag).mkdir(exist_ok=True)
+    kw = dict(optimizer_sharding="zero1", zero_min_size=0,
+              sharded_checkpoint=True)
+    if "pipe" in mesh_spec:
+        kw["pipe_schedule"] = schedule
+    t, _ = _make_trainer(
+        work / tag, mesh_spec=mesh_spec, dropout=0.0, n_epochs=1,
+        batch_split=2, **kw,
+    )
+    ckpt = work / "pipe_stage.ch"
+    if ckpt.exists():
+        t.load_state_dict(ckpt)
+        resumed_from = t.global_step
+        assert resumed_from > 0, "resume did not restore the step"
+        # a STAGE-SHARDED save must restore bit-for-bit on host, whatever
+        # the live layout (wider data axis / other schedule / no pipe)
+        want = np.load(work / "params_checksum.npy")
+        leaves = jax.tree_util.tree_leaves(gather_to_host(t.params))
+        got = np.float64(sum(np.asarray(l, np.float64).sum() for l in leaves))
+        assert abs(got - want) < 1e-6, (got, want)
+        t.n_epochs = 1
+        t.train()
+        assert t.global_step > resumed_from
+        final = gather_to_host(t.params)
+        flat = {}
+        def _walk(tree, prefix=""):
+            for k, v in tree.items():
+                key = prefix + "/" + str(k) if prefix else str(k)
+                if isinstance(v, dict):
+                    _walk(v, key)
+                else:
+                    flat[key] = np.asarray(v)
+        _walk(final)
+        np.savez(work / ("final_" + out_tag + ".npz"), **flat)
+        print(f"RESUMED_OK mesh={mesh_spec} schedule={schedule} "
+              f"step={t.global_step}", flush=True)
+    else:
+        from ml_recipe_tpu.train.checkpoint import peek_checkpoint_layout
+        t.train()
+        t.save_state_dict(ckpt)
+        leaves = jax.tree_util.tree_leaves(gather_to_host(t.params))
+        total = np.float64(sum(np.asarray(l, np.float64).sum() for l in leaves))
+        np.save(work / "params_checksum.npy", total)
+        layout = peek_checkpoint_layout(ckpt)
+        assert layout["pipe_schedule"] == schedule, layout
+        assert layout["pipe_param_layout"] == "stage", layout
+        print(f"SAVED_OK step={t.global_step}", flush=True)
+    """
+)
+
+
+@pytest.mark.slow
+def test_pipe_stage_checkpoint_reshape_and_schedule_flip(tmp_path):
+    """ISSUE-19 acceptance drill: a STAGE-SHARDED save at ``data:2,pipe:2``
+    (trunk leaves over pipe x data) restores onto a pipe-less ``data:4``
+    plan bit-for-bit, and a gpipe save resumes under ``--pipe_schedule
+    1f1b`` — the continued trajectories of the two schedules agree within
+    the PR-15 pipeline tolerance (identical data order; the schedules
+    reorder the same microbatch work). Process-per-topology like the
+    zero-reshape drill."""
+    script = tmp_path / "phase.py"
+    script.write_text(_PIPE_STAGE_RESHAPE_TRAIN)
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+
+    def phase(mesh_spec, schedule, out_tag):
+        proc = subprocess.run(
+            [sys.executable, str(script), str(tmp_path), mesh_spec,
+             schedule, out_tag, tests_dir],
+            capture_output=True, text=True, timeout=900,
+            cwd=tests_dir,
+        )
+        assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-4000:]
+        return proc.stdout
+
+    # save under gpipe with stage-local trunk storage
+    out = phase("data:2,pipe:2", "gpipe", "save")
+    assert "SAVED_OK" in out
+
+    from ml_recipe_tpu.train.checkpoint import peek_checkpoint_layout
+
+    layout = peek_checkpoint_layout(tmp_path / "pipe_stage.ch")
+    assert layout["mesh_axes"] == {"data": 2, "pipe": 2}
+    assert layout["pipe_schedule"] == "gpipe"
+    assert layout["pipe_param_layout"] == "stage"
+    # widest leaf shards pipe x data ways
+    assert layout["shards"] == 4
+
+    # stage-sharded save -> pipe-less wider data axis
+    assert "RESUMED_OK mesh=data:4" in phase("data:4", "gpipe", "data4")
+    # schedule-flip resume: same mesh, gpipe save -> 1f1b continuation
+    assert "RESUMED_OK mesh=data:2,pipe:2 schedule=1f1b" in phase(
+        "data:2,pipe:2", "1f1b", "flip1f1b"
+    )
+    # reference continuation under the saved schedule
+    assert "RESUMED_OK mesh=data:2,pipe:2 schedule=gpipe" in phase(
+        "data:2,pipe:2", "gpipe", "flipgpipe"
+    )
+    ref = np.load(tmp_path / "final_flipgpipe.npz")
+    got = np.load(tmp_path / "final_flip1f1b.npz")
+    assert set(ref.files) == set(got.files)
+    for k in ref.files:
+        np.testing.assert_allclose(
+            got[k], ref[k], rtol=1e-4, atol=1e-5,
+            err_msg=f"schedule-flip trajectory diverged at {k}",
+        )
 
 
 # ---------------------------------------------------------------------------

@@ -1484,3 +1484,196 @@ def test_masks_share_one_path_walk_and_compose_with_zero1(tmp_path):
                 x, y, err_msg=f"frozen leaf {path} changed under zero1"
             )
     assert changed_any, "no fine-tuned leaf moved"
+
+
+# ---------------------------------------------------------------------------
+# Stage-local param/optimizer storage + 1F1B schedule (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+def _sds(*shape):
+    return jax.ShapeDtypeStruct(shape, jnp.float32)
+
+
+def _stage_probe_params():
+    """A QA-shaped ShapeDtypeStruct tree with power-of-two trunk dims —
+    the modeled-bytes tests need the real key layout (stage scope is
+    path-driven) but no devices."""
+    def layer():
+        return {"kernel": _sds(64, 64), "bias": _sds(64)}
+
+    return {
+        "transformer": {
+            "embeddings": {"word_embeddings": _sds(128, 64)},
+            "layer_0": layer(), "layer_1": layer(),
+            "layer_2": layer(), "layer_3": layer(),
+            "pooler": {"kernel": _sds(64, 64)},
+        },
+        "classifier": {"kernel": _sds(64, 5)},
+    }
+
+
+def test_stage_param_bytes_mocked_pipe_counts():
+    """ISSUE-19 acceptance (modeled side, mocked stage counts K=2/4 — no
+    mesh, no devices): stage-local storage puts per-chip param bytes at
+    trunk/K + heads, i.e. within (1/K + eps) of the replicated footprint
+    where eps is exactly the replicated pooler/head fraction."""
+    from ml_recipe_tpu.parallel.pipeline import stage_param_bytes
+
+    params = _stage_probe_params()
+    trunk = (128 * 64 + 4 * (64 * 64 + 64)) * 4
+    heads = (64 * 64 + 64 * 5) * 4
+    for K in (2, 4):
+        out = stage_param_bytes(params, pipe_size=K)
+        assert out["pipe_size"] == K
+        assert out["replicated_bytes"] == trunk + heads
+        # every trunk dim divides K (powers of two): exact 1/K, no padding
+        assert out["per_chip_bytes"] == trunk // K + heads
+        eps = heads / (trunk + heads)
+        assert (
+            out["per_chip_bytes"]
+            <= (1 / K + eps) * out["replicated_bytes"] + 1e-6
+        )
+        # ownership view conserves every byte; embeddings live with rank 0,
+        # pooler/heads with the last stage
+        per_stage = out["per_stage_bytes"]
+        assert set(per_stage) == set(range(K))
+        assert sum(per_stage.values()) == trunk + heads
+        assert per_stage[0] >= 128 * 64 * 4
+        assert per_stage[K - 1] >= heads
+
+
+def test_zero1_under_pipe_modeled_bytes_compose():
+    """zero1_state_bytes at a mocked data:2 x pipe:2: stage-scope moment
+    leaves divide by BOTH axes (pipe claims its dim first, the padded-leaf
+    data plan runs on what remains), pooler/head moments by data alone. A
+    1-d trunk bias whose only dim the pipe axis claims stays data-
+    replicated — the stage-local leaf set has nothing left to shard."""
+    from ml_recipe_tpu.parallel.sharding import zero1_state_bytes
+
+    params = _stage_probe_params()
+    state = {"mu": params, "nu": params}
+    both = zero1_state_bytes(state, data_size=2, min_size=0, pipe_size=2)
+    data_only = zero1_state_bytes(state, data_size=2, min_size=0)
+    emb, kernel, bias = 128 * 64 * 4, 64 * 64 * 4, 64 * 4
+    pooler, classifier = 64 * 64 * 4, 64 * 5 * 4
+    per_moment_repl = emb + 4 * (kernel + bias) + pooler + classifier
+    assert data_only["replicated_bytes"] == 2 * per_moment_repl
+    assert data_only["zero1_bytes"] == 2 * (per_moment_repl // 2)
+    per_moment_both = (
+        emb // 4                 # pipe on rows, data on cols
+        + 4 * (kernel // 4       # pipe + data on the two 64-dims
+               + bias // 2)      # pipe claims the ONLY dim: no data shard
+        + pooler // 2 + classifier // 2  # heads: data only
+    )
+    assert both["zero1_bytes"] == 2 * per_moment_both
+    assert both["zero1_bytes"] < data_only["zero1_bytes"]
+
+
+def test_zero1_under_pipe_repads_on_stage_local_extents(tmp_path):
+    """ISSUE-19: the ZeRO-1 padded-leaf plan under pipe runs WITHIN each
+    stage's leaf set — pipe claims a divisible stage-scope dim with no
+    padding, then the data axis pads its own (remaining) dim exactly as it
+    would without pipe."""
+    from ml_recipe_tpu.parallel.sharding import zero1_plan
+
+    mesh = build_mesh("data:2,pipe:2")
+    tree = {
+        "mu": {
+            "transformer": {
+                # both dims divide: pipe takes one, data the other, no pad
+                "layer_0": {"kernel": _sds(16, 16),
+                            # 17 divides neither axis: pipe skips it (no
+                            # padding on the pipe dim, ever), data pads
+                            # 17 -> 18
+                            "odd": _sds(17)},
+            },
+            # head leaf: pipe never touches it, data pads 17 -> 18 the
+            # same way it does without a pipe axis
+            "classifier": {"odd": _sds(17)},
+        }
+    }
+    zplan = zero1_plan(tree, mesh, min_size=0, stage_pipe=True)
+    kernel = zplan["mu"]["transformer"]["layer_0"]["kernel"]
+    assert "pipe" in tuple(kernel.spec) and "data" in tuple(kernel.spec)
+    assert kernel.padded == 16  # data dim present, unpadded
+    trunk_odd = zplan["mu"]["transformer"]["layer_0"]["odd"]
+    head_odd = zplan["mu"]["classifier"]["odd"]
+    for leaf in (trunk_odd, head_odd):
+        assert leaf.axis == 0 and leaf.padded == 18
+        assert "pipe" not in (leaf.spec[0] or ())
+    # and the no-pipe plan pads the head leaf identically: stage-local
+    # re-padding changed nothing outside the stage scope
+    flat = zero1_plan(tree, mesh, min_size=0, stage_pipe=False)
+    assert flat["mu"]["classifier"]["odd"].padded == 18
+
+
+def test_pipe_stage_preflight_byte_ratio(tmp_path):
+    """ISSUE-19 acceptance (measured side): at data:2,pipe:2 the pre-flight
+    report's param_bytes and opt_state_bytes_per_chip under stage-local
+    storage land at <= (1/K + eps) of the replicated run's, eps being the
+    replicated pooler/head share."""
+    from ml_recipe_tpu.parallel.pipeline import stage_param_bytes
+
+    s, _ = _make_trainer(tmp_path, mesh_spec="data:2,pipe:2", batch_split=2,
+                         optimizer_sharding="zero1", zero_min_size=0)
+    (tmp_path / "r").mkdir()
+    r, _ = _make_trainer(tmp_path / "r", mesh_spec="data:2,pipe:2",
+                         batch_split=2, optimizer_sharding="zero1",
+                         zero_min_size=0, pipe_param_sharding="replicated")
+    assert s._stage_param_specs is not None and r._stage_param_specs is None
+    rep_s = s.preflight_train_step(
+        None, None, compile_fn=_fake_compile_fn([]), limit_bytes=10_000)
+    rep_r = r.preflight_train_step(
+        None, None, compile_fn=_fake_compile_fn([]), limit_bytes=10_000)
+    model = stage_param_bytes(r.params, pipe_size=2)
+    K = 2
+    # per_chip = trunk/K + heads  =>  trunk = (replicated - per_chip)*K/(K-1)
+    trunk = (model["replicated_bytes"] - model["per_chip_bytes"]) * K // (K - 1)
+    eps = (model["replicated_bytes"] - trunk) / model["replicated_bytes"]
+    assert rep_r["param_bytes"] == model["replicated_bytes"]
+    assert rep_s["param_bytes"] == model["per_chip_bytes"]
+    assert (
+        rep_s["param_bytes"]
+        <= (1 / K + eps) * rep_r["param_bytes"] + 1e-6
+    )
+    # optimizer state: ZeRO-1 over data WITHIN the stage's leaf set — the
+    # stage run's per-chip moments also drop to ~1/K of the replicated
+    # run's (both already divide by data)
+    assert (
+        rep_s["opt_state_bytes_per_chip"]
+        <= (1 / K + eps) * rep_r["opt_state_bytes_per_chip"] + 1e-6
+    )
+    # both reports name the layout they measured
+    assert rep_s["pipe_param_layout"] == "stage"
+    assert rep_r["pipe_param_layout"] == "replicated"
+
+
+def test_pipe_1f1b_compiled_peak_below_gpipe(tmp_path):
+    """ISSUE-19 acceptance (CPU smoke): at m=4 microbatches over K=2
+    stages, the compiled 1F1B program's projected peak bytes
+    (memory_analysis: args + outputs + temps - aliased) land strictly
+    below gpipe's — the in-flight window (min(m, 2K-1) = 3 resident
+    stage inputs) beats gpipe's all-m resident activations."""
+    from ml_recipe_tpu.data.bucketing import synthetic_qa_batch
+    from ml_recipe_tpu.utils.hbm import preflight_bytes
+
+    host_in, host_lab = synthetic_qa_batch(16, MAX_SEQ_LEN)
+    peaks = {}
+    for sched in ("gpipe", "1f1b"):
+        (tmp_path / sched).mkdir()
+        tr, _ = _make_trainer(tmp_path / sched, mesh_spec="data:2,pipe:2",
+                              batch_split=4, dropout=0.0,
+                              pipe_schedule=sched)
+        with tr.mesh:
+            step = tr._build_train_step()
+            di = tr._global_batch(tr._split_micro(host_in),
+                                  leading_accum=True)
+            dl = tr._global_batch(tr._split_micro(host_lab),
+                                  leading_accum=True)
+            compiled = step.lower(
+                tr.params, tr.opt_state, di, dl, 0
+            ).compile()
+            peaks[sched] = preflight_bytes(compiled.memory_analysis())
+    assert peaks["1f1b"] is not None and peaks["gpipe"] is not None
+    assert peaks["1f1b"] < peaks["gpipe"], peaks
