@@ -780,6 +780,8 @@ def bench_serve(args) -> None:
             args, model, params, make_batches)
         quantize = quant_fields["quantize"]
 
+        long_doc_tokens = int(
+            getattr(args, "serve_long_doc_tokens", 0) or 0)
         engine = QAEngine(
             model, params, tokenizer, grid=grid, mesh=mesh,
             max_batch_delay_ms=args.max_batch_delay_ms,
@@ -788,6 +790,10 @@ def bench_serve(args) -> None:
             quantize=quantize,
             serve_cache_bytes=int(getattr(args, "serve_cache_bytes", 0) or 0),
             doc_cache_bytes=int(getattr(args, "doc_cache_bytes", 0) or 0),
+            # the long leg needs the scatter path on: any multi-chunk
+            # request co-schedules; short-doc closed-loop traffic (single
+            # chunk at these grids) is unaffected
+            long_scatter_chunks=2 if long_doc_tokens else 0,
         )
         warm = engine.warmup(hbm_preflight=args.hbm_preflight)
 
@@ -840,6 +846,46 @@ def bench_serve(args) -> None:
         for t in threads:
             t.join()
         elapsed = time.perf_counter() - t0
+
+        # long-request leg (ISSUE 20): one synthetic document of
+        # --serve_long_doc_tokens tokens through the long buckets; its
+        # sliding-window chunks scatter chunk-parallel across dedicated
+        # batches (engine long_scatter_chunks) instead of trickling
+        # through deadline coalescing. Repeated --serve_long_requests
+        # times for a latency sample; runs after the timed closed loop so
+        # it never perturbs the headline numbers.
+        longdoc = {
+            "longdoc_tokens": long_doc_tokens or None,
+            "longdoc_chunks": None,
+            "longdoc_scatter_batches": None,
+            "longdoc_p50_ms": None,
+            "longdoc_p95_ms": None,
+        }
+        if long_doc_tokens:
+            base = uniques[0]["document_text"]
+            n_rep = max(1, -(-long_doc_tokens //
+                             max(1, len(tokenizer.encode(base)))))
+            long_document = " ".join([base] * n_rep)
+            long_question = uniques[0]["question_text"]
+            long_ms = []
+            n_chunks = scatter_batches = 0
+            for _ in range(max(1, int(
+                    getattr(args, "serve_long_requests", 1) or 1))):
+                t_req = time.perf_counter()
+                ticket = engine.submit(long_question, long_document)
+                ticket.result(timeout=600)
+                long_ms.append((time.perf_counter() - t_req) * 1e3)
+                n_chunks = ticket.n_chunks
+                scatter_batches = ticket.scatter_batches
+            longdoc.update(
+                longdoc_chunks=n_chunks,
+                longdoc_scatter_batches=scatter_batches,
+                longdoc_p50_ms=round(
+                    float(np.percentile(long_ms, 50)), 2),
+                longdoc_p95_ms=round(
+                    float(np.percentile(long_ms, 95)), 2),
+            )
+
         engine.close()
 
         # rolling-restart leg of --aot_cold_warm_probe: a replacement
@@ -920,6 +966,9 @@ def bench_serve(args) -> None:
                     "doc_cache_hit_rate": hit_rate(cache["doc"]),
                     "chunk_cache": cache["chunk"],
                     "doc_cache": cache["doc"],
+                    # long-request leg provenance (ISSUE 20): how the 16k+
+                    # document scattered, and what it cost end to end
+                    **longdoc,
                     **quant_fields,
                     "max_batch_delay_ms": args.max_batch_delay_ms,
                     "warmup_seconds": warm["warmup_seconds"],
@@ -1470,6 +1519,18 @@ def main() -> None:
                         help="serve mode: tier-1 document-preprocessing "
                              "cache byte budget (plain bytes or K/M/G "
                              "suffix; 0 = off)")
+    parser.add_argument("--serve_long_doc_tokens", type=int, default=0,
+                        help="serve mode: long-request leg (ISSUE 20) — "
+                             "after the closed loop, drive one synthetic "
+                             "document of this many tokens through the "
+                             "long buckets; its sliding-window chunks "
+                             "scatter chunk-parallel across dedicated "
+                             "batches and the JSON gains longdoc_chunks/"
+                             "longdoc_scatter_batches + longdoc p50/p95. "
+                             "0 = leg off")
+    parser.add_argument("--serve_long_requests", type=int, default=4,
+                        help="serve mode: repeats of the long-request leg "
+                             "document (the longdoc p50/p95 sample size)")
     # --mode fleet knobs (router tier over N in-process engines; reuses the
     # serve_* knobs for the engine plane and the closed-loop client count)
     parser.add_argument("--fleet_engines", type=int, default=2,
@@ -1635,8 +1696,15 @@ def main() -> None:
 
     cfg = MODEL_PRESETS[args.model]
     cfg = _widen_positions(cfg, args.seq_len)
-    model = QAModel(cfg, dtype=jnp.bfloat16, attention_impl="auto",
-                    ln_impl=args.ln_impl, remat=args.remat)
+    # a seq axis in --mesh selects ring attention — whose inner step runs the
+    # composed streaming-KV kernels whenever the local length has a legal
+    # streaming geometry (mirrors compose.init_model's 'auto' resolution);
+    # this is the seq-4096/8192 long-document regime
+    seq_parallel = plan.seq_size > 1
+    model = QAModel(cfg, dtype=jnp.bfloat16,
+                    attention_impl="ring" if seq_parallel else "auto",
+                    ln_impl=args.ln_impl, remat=args.remat,
+                    mesh=mesh if seq_parallel else None)
 
     class TP:
         loss = "smooth"; smooth_alpha = 0.01; focal_alpha = 1; focal_gamma = 2
@@ -1646,9 +1714,22 @@ def main() -> None:
 
     rng = np.random.default_rng(0)
     B, L = args.global_batch, args.seq_len
-    params = model.init(
-        jax.random.key(0), np.zeros((1, 8), dtype=np.int32)
-    )["params"]
+
+    def _init_params():
+        # init through an XLA-attention twin under ring: param structure is
+        # identical across attention impls, and ring's shard_map rejects the
+        # tiny init example shape (same trick as compose.init_model)
+        import dataclasses as _dc
+
+        init_module = (
+            _dc.replace(model, attention_impl="xla", mesh=None)
+            if model.attention_impl == "ring" else model
+        )
+        return init_module.init(
+            jax.random.key(0), np.zeros((1, 8), dtype=np.int32)
+        )["params"]
+
+    params = _init_params()
 
     # test-only Trainer skips optimizer construction; build it for the bench
     from ml_recipe_tpu.train.optim import build_optimizer
@@ -2037,6 +2118,10 @@ def main() -> None:
                 "aot_hits": aot_summary["hits"],
                 "aot_misses": aot_summary["misses"],
                 "cold_vs_warm_compile_s": aot_probe,
+                # 'ring' under a seq-bearing --mesh: the composed
+                # streaming-ring long-document path (the seq-4096/8192
+                # baseline rows key off this)
+                "attention_impl": model.attention_impl,
                 "ln_impl": args.ln_impl,
                 "n_chips": n_chips,
                 "backend": jax.default_backend(),

@@ -84,6 +84,7 @@ def main(params, model_params) -> int:
         quantize=getattr(params, "quantize", "off"),
         serve_cache_bytes=getattr(params, "serve_cache_bytes", 0),
         doc_cache_bytes=getattr(params, "doc_cache_bytes", 0),
+        long_scatter_chunks=getattr(params, "long_scatter_chunks", 0),
     )
     engine.warmup(hbm_preflight=params.hbm_preflight)
 

@@ -117,6 +117,20 @@ def init_model(
     cfg = resolve_model_config(model_params, num_labels=len(RawPreprocessor.labels2id))
     dtype = jnp.bfloat16 if getattr(model_params, "compute_dtype", "bfloat16") == "bfloat16" else jnp.float32
     attention_impl = getattr(model_params, "flash_attention", "auto") or "auto"
+    if attention_impl == "auto" and mesh is not None:
+        from .parallel.sharding import SEQ_AXIS
+
+        if SEQ_AXIS in mesh.axis_names and mesh.shape[SEQ_AXIS] > 1:
+            # a seq axis in the mesh IS the long-context request: route
+            # attention through the ring dispatcher, which consumes each
+            # visiting K/V shard via the composed streaming inner when a
+            # legal geometry exists at the local length
+            attention_impl = "ring"
+            logger.info(
+                "Mesh has seq:%d — attention_impl auto-selected 'ring' "
+                "(composed streaming-ring for long documents).",
+                mesh.shape[SEQ_AXIS],
+            )
     model = QAModel(
         cfg,
         dtype=dtype,

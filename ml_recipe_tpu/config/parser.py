@@ -915,6 +915,14 @@ def get_serve_parser() -> ConfigArgumentParser:
                         help="Max question length in tokens.")
     parser.add_argument("--doc_stride", type=int, default=128,
                         help="Sliding-window stride for request chunking.")
+    parser.add_argument("--long_scatter_chunks", type=int, default=0,
+                        help="Long-request scatter threshold: a request "
+                             "whose document windows into at least this "
+                             "many chunks bypasses deadline coalescing and "
+                             "launches its chunks chunk-parallel as "
+                             "dedicated batches (BucketGrid.scatter_plan) "
+                             "— a whole book answers in one POST /v1/qa "
+                             "call. 0 disables the path.")
 
     parser.add_argument("--mesh", type=cast2(str), default=None,
                         help=MESH_HELP)

@@ -100,15 +100,10 @@ def dot_product_attention(
     every implementation to the BLOCK-DIAGONAL mask of sequence packing:
     query i attends key j iff ``seg[i] == seg[j] != 0``. The ids array
     subsumes the key-validity mask (``seg > 0``), so ``mask`` is ignored
-    when it is given. Not supported by ``impl='ring'`` (packing targets the
-    short-chunk regime; ring is the long-context one).
+    when it is given. Under ``impl='ring'`` segment ids need the composed
+    streaming-ring inner (a legal streaming geometry at the local shard
+    length); ring_attention raises otherwise.
     """
-    if segment_ids is not None and impl == "ring":
-        raise ValueError(
-            "segment_ids (sequence packing) is not supported by ring "
-            "attention; packed rows are single-chip shapes — use "
-            "impl='auto'/'pallas'/'xla'"
-        )
     if impl == "ring":
         from ..parallel.sharding import DATA_AXIS, SEQ_AXIS
         from .ring_attention import ring_attention
@@ -124,10 +119,13 @@ def dot_product_attention(
             else None
         )
         seed = _dropout_seed(dropout_rng) if dropout_rate > 0.0 else None
+        # segment_ids route through the composed streaming-ring inner
+        # (ring_attention raises when no legal geometry exists at the
+        # local length — the dense inner is unsegmented)
         return ring_attention(
             q, k, v, mask, mesh=mesh, axis_name=SEQ_AXIS,
             batch_axis=batch_axis, dtype=dtype,
-            rate=dropout_rate, seed=seed,
+            rate=dropout_rate, seed=seed, segment_ids=segment_ids,
         )
 
     if impl in ("auto", "pallas"):

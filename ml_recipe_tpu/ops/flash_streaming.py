@@ -111,11 +111,16 @@ def _stream_candidates(L: int, H: int, D: int):
 
 
 def _streaming_geometry(L, H, D, in_dtype, out_dtype, rate,
-                        mask_dtype=None, interpret=False, seg=False):
+                        mask_dtype=None, interpret=False, seg=False,
+                        ring=False):
     """(blk, hc) for the streaming kernels through the autotuner, or
     ``None``. One geometry serves both directions, so the probe compiles
     the forward AND the heavier dk/dv backward — a candidate is legal only
-    when both lower."""
+    when both lower. ``ring`` keys the composed streaming-ring regime
+    separately (``-ring`` cache-key suffix): there ``L`` is the LOCAL
+    shard length and the kernels carry the extra base/global-hash operands,
+    so a cached single-chip pick must never be reused for it (nor vice
+    versa)."""
     in_isz = jnp.dtype(in_dtype).itemsize
     out_isz = jnp.dtype(out_dtype).itemsize
     mask_dtype = jnp.dtype(mask_dtype) if mask_dtype is not None else (
@@ -137,6 +142,7 @@ def _streaming_geometry(L, H, D, in_dtype, out_dtype, rate,
         aggressive = ref is None or cost(geom) < cost(ref)
         fwd_args = [
             jax.ShapeDtypeStruct((1,), jnp.int32),          # row seeds
+            jax.ShapeDtypeStruct((2,), jnp.int32),          # [row, col] base
             jax.ShapeDtypeStruct((1, 1, L), mask_dtype),    # mask
             *[jax.ShapeDtypeStruct((1, L, H * D), in_dtype)] * 3,  # q k v
         ]
@@ -147,6 +153,7 @@ def _streaming_geometry(L, H, D, in_dtype, out_dtype, rate,
             return False
         dkv_args = [
             jax.ShapeDtypeStruct((1,), jnp.int32),          # row seeds
+            jax.ShapeDtypeStruct((2,), jnp.int32),          # [row, col] base
             jax.ShapeDtypeStruct((1, 1, L), mask_dtype),    # mask
             *[jax.ShapeDtypeStruct((1, L, H * D), in_dtype)] * 4,  # k v q g
             jax.ShapeDtypeStruct((1, L, H * D), out_dtype),  # out residual
@@ -164,7 +171,8 @@ def _streaming_geometry(L, H, D, in_dtype, out_dtype, rate,
     return autotune.get().select(
         "stream",
         L=L, H=H, D=D, in_dtype=jnp.dtype(in_dtype), out_dtype=out_dtype,
-        dropout=rate > 0.0, extra=_seg_extra(mask_dtype, seg),
+        dropout=rate > 0.0,
+        extra=_seg_extra(mask_dtype, seg) + ("-ring" if ring else ""),
         candidates=_stream_candidates(L, H, D), cost=cost, probe=probe,
         analytic=analytic, interpret=interpret,
     )
@@ -191,39 +199,55 @@ def supports_streaming(L: int, H: int, D: int, in_itemsize: int,
     ) is not None
 
 
-def _keep_tile(seed_ref, b, bh, L, blk, qi, ki, rate):
+def _keep_tile(seed_ref, base_ref, b, bh, L, blk, qi, ki, rate):
+    """Dropout keep-bits for one (qi, ki) tile.
+
+    ``base_ref`` is the scalar-prefetch ``[row_base, col_base]`` pair: the
+    ABSOLUTE offset of this invocation's q rows / k cols in the global
+    sequence. Single-chip calls pass (0, 0) and ``L`` = the local length —
+    bit-identical to the historical scheme; the composed streaming-ring
+    path passes each hop's shard offsets and ``L`` = the GLOBAL length, so
+    the mask a shard draws for a visiting K/V block is exactly the tile a
+    single-chip kernel would draw at those absolute coordinates."""
     u = _uniform_grid(
         seed_ref[b], bh, L,
-        rows=blk, row_offset=qi * blk,
-        cols=blk, col_offset=ki * blk,
+        rows=blk, row_offset=base_ref[0] + qi * blk,
+        cols=blk, col_offset=base_ref[1] + ki * blk,
     )
     return u >= rate
 
 
-def _stream_mask_tile(mask_ref, blk, qi, ki, seg: bool):
+def _stream_mask_tile(mask_ref, blk, qi, ki, seg: bool,
+                      seg_split: bool = False):
     """The attend-permission tile of one (qi, ki) program.
 
     Unsegmented: mask_ref is the ``(1, 1, blk)`` k-slice block and the tile
     is the historical key-only ``[1, blk]`` broadcast row. Segmented: the
     mask block is the WHOLE ``(1, 1, L)`` segment-id row (its index map is
     constant in qi/ki) and both the q- and k-slices come from dynamic
-    slices of it, giving the ``[blk, blk]`` block-diagonal grid."""
+    slices of it, giving the ``[blk, blk]`` block-diagonal grid.
+    ``seg_split``: the row is ``(1, 1, 2*L)`` with the q-side ids in
+    ``[0:L]`` and the k-side ids in ``[L:2L]`` — the composed ring layout,
+    where the visiting K/V shard's ids differ from the local q shard's."""
     if seg:
+        L_ids = mask_ref.shape[2] // 2 if seg_split else mask_ref.shape[2]
+        k_off = L_ids if seg_split else 0
         qm = mask_ref[0, 0, pl.ds(qi * blk, blk)]
-        km = mask_ref[0, 0, pl.ds(ki * blk, blk)]
+        km = mask_ref[0, 0, pl.ds(k_off + ki * blk, blk)]
         return _allowed_grid(qm, km, True)
     return mask_ref[0, 0, :][None, :] > 0
 
 
-def _stream_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref,
+def _stream_fwd_kernel(seed_ref, base_ref, mask_ref, q_ref, k_ref, v_ref,
                        o_ref, lse_ref, acc_ref, m_ref, l_ref,
                        *, scale: float, rate: float, hc: int, D: int,
-                       L: int, seg: bool = False):
+                       L: int, seg: bool = False, seg_split: bool = False):
     b, hj, qi, ki = (pl.program_id(0), pl.program_id(1),
                      pl.program_id(2), pl.program_id(3))
     nk = pl.num_programs(3)
     blk = q_ref.shape[1]
-    allowed = _stream_mask_tile(mask_ref, blk, qi, ki, seg)
+    allowed = _stream_mask_tile(mask_ref, blk, qi, ki, seg,
+                                seg_split=seg_split)
     first = ki == 0
     for h in range(hc):
         sl = slice(h * D, (h + 1) * D)
@@ -253,7 +277,8 @@ def _stream_fwd_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref,
         l_new = alpha * l_old + jnp.sum(e, axis=-1, keepdims=True)
 
         if rate > 0.0:
-            keep = _keep_tile(seed_ref, b, hj * hc + h, L, blk, qi, ki, rate)
+            keep = _keep_tile(seed_ref, base_ref, b, hj * hc + h, L, blk,
+                              qi, ki, rate)
             e_av = jnp.where(keep, e * (1.0 / (1.0 - rate)), 0.0)
         else:
             e_av = e
@@ -311,19 +336,21 @@ def _stream_tile_ds(q, k, v, g, out, lse, allowed, scale, keep, rate,
     return p_drop, ds
 
 
-def _stream_dq_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
-                      out_ref, lse_ref, dq_ref, dqa_ref,
+def _stream_dq_kernel(seed_ref, base_ref, mask_ref, q_ref, k_ref, v_ref,
+                      g_ref, out_ref, lse_ref, dq_ref, dqa_ref,
                       *, scale: float, rate: float, hc: int, D: int,
-                      L: int, seg: bool = False):
+                      L: int, seg: bool = False, seg_split: bool = False):
     b, hj, qi, ki = (pl.program_id(0), pl.program_id(1),
                      pl.program_id(2), pl.program_id(3))
     nk = pl.num_programs(3)
     blk = q_ref.shape[1]
-    allowed = _stream_mask_tile(mask_ref, blk, qi, ki, seg)
+    allowed = _stream_mask_tile(mask_ref, blk, qi, ki, seg,
+                                seg_split=seg_split)
     for h in range(hc):
         sl = slice(h * D, (h + 1) * D)
         keep = (
-            _keep_tile(seed_ref, b, hj * hc + h, L, blk, qi, ki, rate)
+            _keep_tile(seed_ref, base_ref, b, hj * hc + h, L, blk, qi, ki,
+                       rate)
             if rate > 0.0 else None
         )
         kk = k_ref[0, :, sl]
@@ -344,21 +371,24 @@ def _stream_dq_kernel(seed_ref, mask_ref, q_ref, k_ref, v_ref, g_ref,
             dq_ref[0, :, sl] = (dq_acc * scale).astype(dq_ref.dtype)
 
 
-def _stream_dkv_kernel(seed_ref, mask_ref, k_ref, v_ref, q_ref, g_ref,
-                       out_ref, lse_ref, dk_ref, dv_ref, dka_ref, dva_ref,
-                       *, scale: float, rate: float, hc: int, D: int,
-                       L: int, seg: bool = False):
+def _stream_dkv_kernel(seed_ref, base_ref, mask_ref, k_ref, v_ref, q_ref,
+                       g_ref, out_ref, lse_ref, dk_ref, dv_ref, dka_ref,
+                       dva_ref, *, scale: float, rate: float, hc: int,
+                       D: int, L: int, seg: bool = False,
+                       seg_split: bool = False):
     # note the grid: (B, HJ, nk, nq) — q INNERMOST, so the dk/dv scratch
     # accumulates across the whole q sweep while k/v blocks stay resident
     b, hj, ki, qi = (pl.program_id(0), pl.program_id(1),
                      pl.program_id(2), pl.program_id(3))
     nq = pl.num_programs(3)
     blk = k_ref.shape[1]
-    allowed = _stream_mask_tile(mask_ref, blk, qi, ki, seg)
+    allowed = _stream_mask_tile(mask_ref, blk, qi, ki, seg,
+                                seg_split=seg_split)
     for h in range(hc):
         sl = slice(h * D, (h + 1) * D)
         keep = (
-            _keep_tile(seed_ref, b, hj * hc + h, L, blk, qi, ki, rate)
+            _keep_tile(seed_ref, base_ref, b, hj * hc + h, L, blk, qi, ki,
+                       rate)
             if rate > 0.0 else None
         )
         q = q_ref[0, :, sl]
@@ -386,33 +416,42 @@ def _stream_dkv_kernel(seed_ref, mask_ref, k_ref, v_ref, q_ref, g_ref,
             dv_ref[0, :, sl] = dv_acc.astype(dv_ref.dtype)
 
 
-def _stream_mask_spec(L, blk, *, k_index, seg: bool):
+def _stream_mask_spec(L, blk, *, k_index, seg: bool, seg_split: bool = False):
     """Mask BlockSpec of the streaming kernels: the historical ``(1, 1,
     blk)`` k-slice, or — segment-aware — the whole ``(1, 1, L)`` id row
     (constant index map, so Pallas keeps it resident; the kernel slices
-    both the q and k sides dynamically)."""
+    both the q and k sides dynamically). ``seg_split`` doubles the row to
+    ``(1, 1, 2L)`` — q-side ids then k-side ids, the composed ring
+    layout."""
     if seg:
-        return pl.BlockSpec((1, 1, L), lambda b, hj, i, j, *_: (b, 0, 0))
+        width = 2 * L if seg_split else L
+        return pl.BlockSpec((1, 1, width), lambda b, hj, i, j, *_: (b, 0, 0))
     if k_index == 2:
         return pl.BlockSpec((1, 1, blk), lambda b, hj, ki, qi, *_: (b, 0, ki))
     return pl.BlockSpec((1, 1, blk), lambda b, hj, qi, ki, *_: (b, 0, ki))
 
 
 def _build_stream_fwd_call(B, L, H, D, in_dtype, out_dtype, rate, blk, hc,
-                           interpret, seg=False):
+                           interpret, seg=False, L_hash=None,
+                           seg_split=False):
     """The streaming forward ``pallas_call`` for one (blk, hc), shared by
     the execution path and the autotuner's compile probe so they cannot
-    drift."""
+    drift. ``L_hash`` keys the dropout hash (the GLOBAL sequence length in
+    the composed ring regime; defaults to ``L``, the local/global length of
+    a single-chip call)."""
     spec_q = pl.BlockSpec((1, blk, hc * D), lambda b, hj, qi, ki, *_: (b, qi, hj))
     spec_k = pl.BlockSpec((1, blk, hc * D), lambda b, hj, qi, ki, *_: (b, ki, hj))
     return pl.pallas_call(
         functools.partial(_stream_fwd_kernel, scale=1.0 / (D ** 0.5),
-                          rate=rate, hc=hc, D=D, L=L, seg=seg),
+                          rate=rate, hc=hc, D=D,
+                          L=L if L_hash is None else L_hash, seg=seg,
+                          seg_split=seg_split),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=(B, H // hc, L // blk, L // blk),
             in_specs=[
-                _stream_mask_spec(L, blk, k_index=3, seg=seg),
+                _stream_mask_spec(L, blk, k_index=3, seg=seg,
+                                  seg_split=seg_split),
                 spec_q, spec_k, spec_k,
             ],
             out_specs=[
@@ -434,35 +473,49 @@ def _build_stream_fwd_call(B, L, H, D, in_dtype, out_dtype, rate, blk, hc,
     )
 
 
+def _zero_base():
+    """The single-chip ``[row_base, col_base]`` scalar-prefetch operand:
+    absolute offsets (0, 0) — the historical hash, bit-for-bit."""
+    return jnp.zeros((2,), dtype=jnp.int32)
+
+
 def _stream_forward(q, k, v, mask, seed, blk, hc, dtype, rate, interpret,
-                    seg=False):
+                    seg=False, base=None, L_hash=None, seg_split=False):
     B, L, H, D = q.shape
     out, lse = _build_stream_fwd_call(B, L, H, D, q.dtype, dtype, rate, blk,
-                                      hc, interpret, seg=seg)(
-        _row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k), _fold(v)
+                                      hc, interpret, seg=seg, L_hash=L_hash,
+                                      seg_split=seg_split)(
+        _row_seeds(seed, B, H),
+        base if base is not None else _zero_base(),
+        mask[:, None, :], _fold(q), _fold(k), _fold(v)
     )
     return out.reshape(B, L, H, D), _lse_unpack(lse, blk, H)
 
 
 def _stream_backward(q, k, v, mask, seed, g, out, lse, blk, hc, dtype, rate,
-                     interpret, seg=False):
+                     interpret, seg=False, base=None, L_hash=None,
+                     seg_split=False):
     B, L, H, D = q.shape
     scale = 1.0 / (D ** 0.5)
     spec_q = pl.BlockSpec((1, blk, hc * D), lambda b, hj, qi, ki, *_: (b, qi, hj))
     spec_k = pl.BlockSpec((1, blk, hc * D), lambda b, hj, qi, ki, *_: (b, ki, hj))
     spec_lse = pl.BlockSpec((1, 1, 1, hc * blk),
                             lambda b, hj, qi, ki, *_: (b, qi, 0, hj))
-    args = (_row_seeds(seed, B, H), mask[:, None, :], _fold(q), _fold(k),
+    args = (_row_seeds(seed, B, H),
+            base if base is not None else _zero_base(),
+            mask[:, None, :], _fold(q), _fold(k),
             _fold(v), _fold(g), _fold(out), _lse_pack(lse, blk))
 
     dq = pl.pallas_call(
         functools.partial(_stream_dq_kernel, scale=scale, rate=rate, hc=hc,
-                          D=D, L=L, seg=seg),
+                          D=D, L=L if L_hash is None else L_hash, seg=seg,
+                          seg_split=seg_split),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=(B, H // hc, L // blk, L // blk),  # (.., nq, nk): k inner
             in_specs=[
-                _stream_mask_spec(L, blk, k_index=3, seg=seg),
+                _stream_mask_spec(L, blk, k_index=3, seg=seg,
+                                  seg_split=seg_split),
                 spec_q, spec_k, spec_k, spec_q, spec_q, spec_lse,
             ],
             out_specs=[spec_q],
@@ -473,17 +526,19 @@ def _stream_backward(q, k, v, mask, seed, g, out, lse, blk, hc, dtype, rate,
     )(*args)[0]
 
     # same residuals, transposed grid: k/v blocks resident, q sweeps
-    dkv_args = (args[0], args[1], args[3], args[4], args[2], args[5],
-                args[6], args[7])
+    dkv_args = (args[0], args[1], args[2], args[4], args[5], args[3],
+                args[6], args[7], args[8])
     dk, dv = _build_stream_dkv_call(B, L, H, D, q.dtype, rate, blk, hc,
                                     interpret, k_dtype=k.dtype,
-                                    v_dtype=v.dtype, seg=seg)(*dkv_args)
+                                    v_dtype=v.dtype, seg=seg, L_hash=L_hash,
+                                    seg_split=seg_split)(*dkv_args)
     return (dq.reshape(B, L, H, D), dk.reshape(B, L, H, D),
             dv.reshape(B, L, H, D))
 
 
 def _build_stream_dkv_call(B, L, H, D, in_dtype, rate, blk, hc, interpret,
-                           k_dtype=None, v_dtype=None, seg=False):
+                           k_dtype=None, v_dtype=None, seg=False,
+                           L_hash=None, seg_split=False):
     """The streaming dk/dv ``pallas_call`` for one (blk, hc) — the heaviest
     of the three streaming kernels (two f32 scratch accumulators), so it is
     the one the autotuner probes alongside the forward. ``k_dtype`` /
@@ -495,12 +550,14 @@ def _build_stream_dkv_call(B, L, H, D, in_dtype, rate, blk, hc, interpret,
     spec_qq = pl.BlockSpec((1, blk, hc * D), lambda b, hj, ki, qi, *_: (b, qi, hj))
     return pl.pallas_call(
         functools.partial(_stream_dkv_kernel, scale=scale, rate=rate, hc=hc,
-                          D=D, L=L, seg=seg),
+                          D=D, L=L if L_hash is None else L_hash, seg=seg,
+                          seg_split=seg_split),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=(B, H // hc, L // blk, L // blk),  # (.., nk, nq): q inner
             in_specs=[
-                _stream_mask_spec(L, blk, k_index=2, seg=seg),
+                _stream_mask_spec(L, blk, k_index=2, seg=seg,
+                                  seg_split=seg_split),
                 spec_kq, spec_kq, spec_qq, spec_qq, spec_qq,
                 pl.BlockSpec((1, 1, 1, hc * blk),
                              lambda b, hj, ki, qi, *_: (b, qi, 0, hj)),

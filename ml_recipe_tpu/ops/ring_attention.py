@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..parallel.compat import shard_map
+
 _NEG_INF = -1e30
 
 
@@ -246,6 +248,183 @@ def _bwd_local(q, k, v, mask, seed, out, lse, do, *, axis_name: str,
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def _stream_row_seeds(seed, *, B: int, H: int, dp_size: int):
+    """GLOBAL per-row dropout seeds for the composed inner, [B] int32.
+
+    Built OUTSIDE the shard_map and sharded over ``batch_axis`` so the
+    composed path never calls ``axis_index`` — XLA's constant sinking
+    clones a ``partition-id``-derived pallas operand into while-loop
+    bodies, where the SPMD partitioner rejects it (the dense inner's
+    in-shard fold never feeds a pallas call, so it is unaffected).
+
+    Bit-compatible with the dense ring AND the single-chip streaming
+    kernels: row ``b`` of dp group ``r`` gets ``seed + r*PRIME +
+    b_local*H*PRIME`` — exactly ``_row_seeds`` applied to the dense
+    path's dp-folded seed (the kernel adds the per-head ``h*PRIME``)."""
+    prime = jnp.int32(-1640531527)
+    rows = jnp.arange(B, dtype=jnp.int32)
+    b_loc = B // dp_size
+    return (
+        seed[0].astype(jnp.int32)
+        + (rows // b_loc) * prime
+        + (rows % b_loc) * jnp.int32(H) * prime
+    )
+
+
+def _merge_hop(o_acc, lse_acc, out_hop, lse_hop):
+    """Fold one hop's normalized streaming output into the running global
+    accumulator. Each hop's kernel returns ``out_hop = N_hop / l_hop`` and
+    ``lse_hop = log(sum_k e^s)`` over the visiting block only, so
+
+        out_global = sum_hop out_hop * exp(lse_hop - lse_global)
+
+    with ``lse_global = logaddexp over hops`` — exact online-softmax
+    across the ``ppermute`` rotation (the within-hop sweep already merged
+    inside the kernel). Holds verbatim under torch-semantics dropout: the
+    undropped denominator is exactly what ``lse`` carries. An all-masked
+    hop arrives with ``lse_hop`` ~ -1e30 and merges with weight zero."""
+    lse_new = jnp.logaddexp(lse_acc, lse_hop)                  # [B,H,Lq]
+    w_acc = jnp.exp(lse_acc - lse_new).transpose(0, 2, 1)[..., None]
+    w_hop = jnp.exp(lse_hop - lse_new).transpose(0, 2, 1)[..., None]
+    return o_acc * w_acc + out_hop.astype(jnp.float32) * w_hop, lse_new
+
+
+def _stream_fwd_local(q, k, v, mask, seed, spos, *, axis_name: str,
+                      rate: float, batch_axis: Optional[str],
+                      blk: int, hc: int, interpret: bool, seg: bool):
+    """Composed streaming-ring forward (runs under shard_map).
+
+    Per hop the visiting K/V shard is consumed by the streaming Pallas
+    forward — per-device activation scratch is O(blk^2) per program
+    instead of the dense inner's O(L_loc^2) block — and the online-softmax
+    state carries across hops via ``_merge_hop``. Dropout keep-bits are
+    keyed by ABSOLUTE (row, col) against the GLOBAL length, bit-identical
+    to the dense ring inner and to a single-chip streaming kernel.
+
+    ``seed``: per-row [B] seeds (``_stream_row_seeds``, dp fold baked in).
+    ``spos``: this shard's [L_loc] slice of the global position iota —
+    ``spos[0]`` is the absolute q-row base, and a copy of it ROTATES with
+    the K/V block (each visiting block carries its own absolute column
+    offset home), so no ``axis_index`` value ever feeds the kernels.
+
+    ``seg``: ``mask`` carries segment ids; the q-side ids stay resident
+    while the k-side copy rotates, concatenated per hop into the
+    ``seg_split`` kernel operand. Unsegmented, ``mask`` is the rotating
+    key-validity row.
+    """
+    from .flash_streaming import _stream_forward
+
+    n_shards = jax.lax.psum(1, axis_name)
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    B, L_loc, H, D = q.shape
+    row_base = spos[:1].astype(jnp.int32)
+
+    def hop(k_cur, v_cur, mask_cur, col_base):
+        mask_arg = (
+            jnp.concatenate([mask, mask_cur], axis=1) if seg else mask_cur
+        )
+        return _stream_forward(
+            q, k_cur, v_cur, mask_arg, seed, blk, hc, jnp.float32,
+            rate, interpret, seg=seg,
+            base=jnp.concatenate([row_base, col_base]),
+            L_hash=n_shards * L_loc, seg_split=seg,
+        )
+
+    def body(i, carry):
+        o_acc, lse_acc, k_cur, v_cur, mask_cur, col_cur = carry
+        out_hop, lse_hop = hop(k_cur, v_cur, mask_cur, col_cur)
+        o_acc, lse_acc = _merge_hop(o_acc, lse_acc, out_hop, lse_hop)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        mask_nxt = jax.lax.ppermute(mask_cur, axis_name, perm)
+        col_nxt = jax.lax.ppermute(col_cur, axis_name, perm)
+        return o_acc, lse_acc, k_nxt, v_nxt, mask_nxt, col_nxt
+
+    o0 = jnp.zeros((B, L_loc, H, D), jnp.float32)
+    lse0 = jnp.full((B, H, L_loc), _NEG_INF, jnp.float32)
+    o, lse, k_last, v_last, mask_last, col_last = jax.lax.fori_loop(
+        0, n_shards - 1, body, (o0, lse0, k, v, mask, row_base)
+    )
+    out_hop, lse_hop = hop(k_last, v_last, mask_last, col_last)
+    o, lse = _merge_hop(o, lse, out_hop, lse_hop)
+    return o.astype(q.dtype), lse
+
+
+def _stream_bwd_local(q, k, v, mask, seed, spos, out, lse, do, *,
+                      axis_name: str, rate: float,
+                      batch_axis: Optional[str],
+                      blk: int, hc: int, interpret: bool, seg: bool):
+    """Composed streaming-ring backward (runs under shard_map).
+
+    The GLOBAL per-row ``lse`` (and global-normalized ``out``) saved by the
+    forward let every hop recompute its block's exact probabilities
+    ``p = exp(s - lse)`` inside the streaming dq/dk/dv kernels — no
+    per-hop renormalisation chain. ``dq`` sums over hops locally in f32;
+    ``dk``/``dv`` partials accumulate in a carry that rotates home with
+    the visiting block (last hop peeled, one final homeward ``ppermute``,
+    exactly the dense inner's schedule). ``seed``/``spos`` as in
+    ``_stream_fwd_local``: per-row seeds and the sharded position iota,
+    with the column base rotating alongside the visiting block."""
+    from .flash_streaming import _stream_backward
+
+    n_shards = jax.lax.psum(1, axis_name)
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    B, L_loc, H, D = q.shape
+    row_base = spos[:1].astype(jnp.int32)
+
+    def hop_grads(k_cur, v_cur, mask_cur, col_base):
+        mask_arg = (
+            jnp.concatenate([mask, mask_cur], axis=1) if seg else mask_cur
+        )
+        return _stream_backward(
+            q, k_cur, v_cur, mask_arg, seed, do, out, lse, blk, hc,
+            jnp.float32, rate, interpret, seg=seg,
+            base=jnp.concatenate([row_base, col_base]),
+            L_hash=n_shards * L_loc, seg_split=seg,
+        )
+
+    def body(i, carry):
+        dq_acc, k_cur, v_cur, mask_cur, col_cur, dk_acc, dv_acc = carry
+        dq_h, dk_h, dv_h = hop_grads(k_cur, v_cur, mask_cur, col_cur)
+        dq_acc = dq_acc + dq_h.astype(jnp.float32)
+        dk_acc = dk_acc + dk_h.astype(jnp.float32)
+        dv_acc = dv_acc + dv_h.astype(jnp.float32)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        mask_nxt = jax.lax.ppermute(mask_cur, axis_name, perm)
+        col_nxt = jax.lax.ppermute(col_cur, axis_name, perm)
+        dk_nxt = jax.lax.ppermute(dk_acc, axis_name, perm)
+        dv_nxt = jax.lax.ppermute(dv_acc, axis_name, perm)
+        return dq_acc, k_nxt, v_nxt, mask_nxt, col_nxt, dk_nxt, dv_nxt
+
+    zeros = lambda: jnp.zeros((B, L_loc, H, D), jnp.float32)  # noqa: E731
+    dq, k_last, v_last, mask_last, col_last, dk, dv = jax.lax.fori_loop(
+        0, n_shards - 1, body,
+        (zeros(), k, v, mask, row_base, zeros(), zeros()),
+    )
+    dq_h, dk_h, dv_h = hop_grads(k_last, v_last, mask_last, col_last)
+    dq = dq + dq_h.astype(jnp.float32)
+    dk = jax.lax.ppermute(dk + dk_h.astype(jnp.float32), axis_name, perm)
+    dv = jax.lax.ppermute(dv + dv_h.astype(jnp.float32), axis_name, perm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def ring_stream_geometry(L_loc: int, H: int, D: int, dtype, rate: float,
+                         *, segmented: bool = False,
+                         interpret: bool = False):
+    """(blk, hc) for the composed streaming-ring inner at LOCAL length
+    ``L_loc``, or None when no legal streaming geometry exists (the caller
+    falls back to the dense inner). Keys the autotune cache with the
+    ``-ring`` suffix so single-chip picks are never reused."""
+    from .flash_streaming import _streaming_geometry
+
+    return _streaming_geometry(
+        L_loc, H, D, jnp.dtype(dtype), jnp.dtype(jnp.float32), rate,
+        mask_dtype=jnp.int32, interpret=interpret,
+        seg=segmented, ring=True,
+    )
+
+
 def ring_attention(
     q,
     k,
@@ -259,6 +438,9 @@ def ring_attention(
     rate: float = 0.0,
     seed=None,
     custom_backward: bool = True,
+    segment_ids=None,
+    inner: str = "auto",
+    interpret: bool = False,
 ):
     """Exact global attention with Q/K/V sharded over ``axis_name``.
 
@@ -276,24 +458,98 @@ def ring_attention(
     residuals). False falls back to plain autodiff through the ring loop —
     kept as the differential-testing oracle (it stores every ring step's
     probability block: correct, but O(L_local · L) memory).
+
+    ``inner``: 'auto' consumes each visiting K/V shard through the
+    streaming Pallas kernels when a legal (blk, hc) geometry exists at the
+    local length (per-device activation scratch O(blk^2) instead of the
+    dense inner's O(L_loc^2)), falling back to the dense inner otherwise.
+    'stream' requires the composed path (raises without a geometry);
+    'dense' forces the historical inner. Results are identical up to f32
+    reduction reordering — dropout masks bit-identical — across inners.
+
+    ``segment_ids``: optional [B, L] packed-segment ids (0 = pad); needs
+    the composed streaming inner (the dense inner is unsegmented).
+    ``interpret``: run the streaming kernels in Pallas interpret mode
+    (forced automatically off-TPU).
     """
     if mask is None:
         mask = jnp.ones(q.shape[:2], dtype=jnp.int32)
     if seed is None:
         seed = jnp.zeros((1,), dtype=jnp.int32)
 
-    scale = 1.0 / (q.shape[-1] ** 0.5)
-    common = dict(axis_name=axis_name, scale=scale, rate=rate,
-                  batch_axis=batch_axis)
+    seg = segment_ids is not None
+    B, L, H, D = q.shape
+    n_shards = int(mesh.shape[axis_name])
+    scale = 1.0 / (D ** 0.5)
+
+    stream_cfg = None
+    if inner in ("auto", "stream") and custom_backward and L % n_shards == 0:
+        interpret = bool(interpret) or jax.default_backend() != "tpu"
+        stream_cfg = ring_stream_geometry(
+            L // n_shards, H, D, dtype, rate, segmented=seg,
+            interpret=interpret,
+        )
+    if stream_cfg is None:
+        if inner == "stream":
+            raise ValueError(
+                f"no legal streaming geometry for the composed ring inner "
+                f"at L_loc={L // n_shards}, H={H}, D={D} (rate={rate}); "
+                f"use inner='dense' or a longer sequence"
+            )
+        if seg:
+            raise NotImplementedError(
+                "segment_ids require the composed streaming-ring inner "
+                "(no legal geometry at this shape, or inner='dense'/"
+                "custom_backward=False was forced); the dense ring inner "
+                "is unsegmented"
+            )
 
     seq_spec = P(batch_axis, axis_name, None, None)
     mask_spec = P(batch_axis, axis_name)
     lse_spec = P(batch_axis, None, axis_name)
 
-    fwd_sm = jax.shard_map(
-        functools.partial(_fwd_local, **common),
+    # The composed inner never calls ``axis_index``: the per-row dropout
+    # seeds fold the dp rank OUTSIDE the shard_map (sharding the [B] row
+    # over ``batch_axis`` hands each dp group exactly the dense path's
+    # in-shard fold), and absolute (row, col) bases come from a sharded
+    # position iota whose column copy ppermutes with the visiting K/V
+    # block. XLA's constant sinking clones ``partition-id``-derived pallas
+    # operands into while-loop bodies, where the SPMD partitioner rejects
+    # them — so no kernel operand may depend on it.
+    if stream_cfg is not None:
+        blk, hc = stream_cfg
+        common = dict(axis_name=axis_name, rate=rate, batch_axis=batch_axis,
+                      blk=blk, hc=hc, interpret=interpret, seg=seg)
+        mask = (
+            jnp.where(mask > 0, segment_ids.astype(jnp.int32), 0)
+            if seg else mask
+        )
+        local_fwd, local_bwd = _stream_fwd_local, _stream_bwd_local
+        dp_size = int(mesh.shape[batch_axis]) if batch_axis is not None else 1
+        seed_arg = _stream_row_seeds(seed, B=B, H=H, dp_size=dp_size)
+        seed_spec = P(batch_axis)
+    else:
+        common = dict(axis_name=axis_name, scale=scale, rate=rate,
+                      batch_axis=batch_axis)
+
+        def local_fwd(q_, k_, v_, mask_, seed_, spos_, **kw):
+            return _fwd_local(q_, k_, v_, mask_, seed_, **kw)
+
+        def local_bwd(q_, k_, v_, mask_, seed_, spos_, out_, lse_, do_,
+                      **kw):
+            return _bwd_local(q_, k_, v_, mask_, seed_, out_, lse_, do_,
+                              **kw)
+
+        seed_arg, seed_spec = seed, P(None)
+
+    spos = jnp.arange(L, dtype=jnp.int32)
+    spos_spec = P(axis_name)
+
+    fwd_sm = shard_map(
+        functools.partial(local_fwd, **common),
         mesh=mesh,
-        in_specs=(seq_spec, seq_spec, seq_spec, mask_spec, P(None)),
+        in_specs=(seq_spec, seq_spec, seq_spec, mask_spec, seed_spec,
+                  spos_spec),
         out_specs=(seq_spec, lse_spec),
         check_vma=False,
     )
@@ -301,29 +557,29 @@ def ring_attention(
     q, k, v = q.astype(dtype), k.astype(dtype), v.astype(dtype)
 
     if not custom_backward:
-        return fwd_sm(q, k, v, mask, seed)[0]
+        return fwd_sm(q, k, v, mask, seed_arg, spos)[0]
 
-    bwd_sm = jax.shard_map(
-        functools.partial(_bwd_local, **common),
+    bwd_sm = shard_map(
+        functools.partial(local_bwd, **common),
         mesh=mesh,
-        in_specs=(seq_spec, seq_spec, seq_spec, mask_spec, P(None),
-                  seq_spec, lse_spec, seq_spec),
+        in_specs=(seq_spec, seq_spec, seq_spec, mask_spec, seed_spec,
+                  spos_spec, seq_spec, lse_spec, seq_spec),
         out_specs=(seq_spec, seq_spec, seq_spec),
         check_vma=False,
     )
 
     @jax.custom_vjp
-    def attn(q_, k_, v_, mask_, seed_):
-        return fwd_sm(q_, k_, v_, mask_, seed_)[0]
+    def attn(q_, k_, v_, mask_, seed_, spos_):
+        return fwd_sm(q_, k_, v_, mask_, seed_, spos_)[0]
 
-    def attn_fwd(q_, k_, v_, mask_, seed_):
-        out, lse = fwd_sm(q_, k_, v_, mask_, seed_)
-        return out, (q_, k_, v_, mask_, seed_, out, lse)
+    def attn_fwd(q_, k_, v_, mask_, seed_, spos_):
+        out, lse = fwd_sm(q_, k_, v_, mask_, seed_, spos_)
+        return out, (q_, k_, v_, mask_, seed_, spos_, out, lse)
 
     def attn_bwd(res, do):
-        q_, k_, v_, mask_, seed_, out, lse = res
-        dq, dk, dv = bwd_sm(q_, k_, v_, mask_, seed_, out, lse, do)
-        return dq, dk, dv, None, None
+        q_, k_, v_, mask_, seed_, spos_, out, lse = res
+        dq, dk, dv = bwd_sm(q_, k_, v_, mask_, seed_, spos_, out, lse, do)
+        return dq, dk, dv, None, None, None
 
     attn.defvjp(attn_fwd, attn_bwd)
-    return attn(q, k, v, mask, seed)
+    return attn(q, k, v, mask, seed_arg, spos)

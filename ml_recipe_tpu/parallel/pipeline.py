@@ -148,10 +148,13 @@ def validate_pipeline_plan(plan, model, *, batch_split: int,
         )
     if plan.seq_size > 1:
         raise NotImplementedError(
-            "--mesh with both seq (ring attention) and pipe axes is not "
-            "composable yet: ring's shard_map cannot nest inside the "
-            "pipeline island's per-tick stage compute (one shard_map "
-            "cannot contain the other's collectives)"
+            "--mesh with both seq and pipe axes is not composable yet: "
+            "the composed streaming-ring attention path (ISSUE 20, "
+            "ops/ring_attention.py) runs under its own shard_map, which "
+            "cannot nest inside the pipeline island's per-tick stage "
+            "compute (one shard_map cannot contain the other's "
+            "collectives). Follow-up: host the ring hop loop inside the "
+            "stage body so the pipe island owns both collectives."
         )
     if batch_split < 1:
         raise ValueError(f"batch_split must be >= 1, got {batch_split}")

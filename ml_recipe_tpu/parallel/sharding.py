@@ -421,6 +421,12 @@ def make_global_array(
             axes = [None] * x.ndim
             if DATA_AXIS in mesh.axis_names:
                 axes[batch_axis] = DATA_AXIS
+            if (shard_seq and x.ndim > batch_axis + 1
+                    and SEQ_AXIS in mesh.axis_names
+                    and mesh.shape[SEQ_AXIS] > 1):
+                # micro-batch-major [G, B, L]: the token dim after the
+                # batch dim rides the seq axis, same as batch_pspec
+                axes[batch_axis + 1] = SEQ_AXIS
             spec = P(*axes)
         sharding = NamedSharding(mesh, spec)
         if jax.process_count() == 1:

@@ -98,6 +98,12 @@ class MicroBatcher:
         self.queue_size = int(queue_size)
 
         self._pending: Dict[int, deque] = {}
+        # long-request scatter groups (ISSUE 20): pre-sliced batches that
+        # launch AS-IS, immediately — one request's chunks co-scheduled
+        # chunk-parallel instead of interleaving with the coalescing queue.
+        # Each entry is one ``(seq, works)`` batch; items count against the
+        # same bounded queue.
+        self._groups: deque = deque()
         self._n_pending = 0
         self._inflight = False
         self._draining = False
@@ -124,6 +130,36 @@ class MicroBatcher:
                 w.enqueued_at = now
                 self._pending.setdefault(w.seq, deque()).append(w)
             self._n_pending += len(works)
+            depth = self._n_pending
+            self._cv.notify_all()
+        if self._on_depth is not None:
+            self._on_depth(depth)
+
+    def submit_group(self, slices: Sequence[Sequence[ChunkWork]]) -> None:
+        """Admit a long request's pre-sliced scatter batches, all or
+        nothing. Every inner slice launches as ONE dedicated batch, ahead
+        of the coalescing queue and with no deadline wait — the request is
+        already complete, so holding its chunks back buys nothing. The
+        engine slices via ``BucketGrid.scatter_plan``; slices share the
+        bounded queue's capacity with ordinary chunk admissions."""
+        slices = [list(s) for s in slices if s]
+        total = sum(len(s) for s in slices)
+        if not total:
+            return
+        now = time.monotonic()
+        with self._cv:
+            if self._draining or self._stopped:
+                raise DrainingError("batcher is draining; not accepting work")
+            if self._n_pending + total > self.queue_size:
+                raise QueueFullError(
+                    f"work queue full ({self._n_pending}/{self.queue_size} "
+                    f"queued, request needs {total} slots)"
+                )
+            for works in slices:
+                for w in works:
+                    w.enqueued_at = now
+                self._groups.append((works[0].seq, works))
+            self._n_pending += total
             depth = self._n_pending
             self._cv.notify_all()
         if self._on_depth is not None:
@@ -221,6 +257,13 @@ class MicroBatcher:
 
     def _take_locked(self) -> Optional[tuple]:
         """Pop the next batch to launch, or None to keep waiting."""
+        if self._groups:
+            # scatter slices are ready-by-construction batches: launch them
+            # before coalescing-queue work so a long request's chunks run
+            # back-to-back (its latency is len(plan) device steps, period)
+            seq, works = self._groups.popleft()
+            self._n_pending -= len(works)
+            return seq, works
         seq = self._full_seq()
         if seq is None:
             eligible = self._eligible_seqs()

@@ -118,6 +118,25 @@ class BucketGrid:
                 return batch
         return self._by_seq[seq][-1]
 
+    def scatter_plan(self, seq: int, n_items: int) -> List[int]:
+        """Batch sizes that run ``n_items`` chunks CHUNK-PARALLEL at
+        ``seq`` in as few program launches as possible: greedy slices of
+        the largest bucket batch, with the remainder admitted into the
+        smallest batch that fits it (least padding). This is the
+        long-request path (ISSUE 20): a document that windows into dozens
+        of chunks launches ``len(plan)`` dedicated batches immediately
+        instead of trickling through deadline coalescing one bucket at a
+        time — with a long-request bucket sized to the windowed chunk
+        count, a whole book answers in ONE device step."""
+        if n_items < 1:
+            return []
+        largest = self.max_batch_for(seq)
+        plan = [largest] * (n_items // largest)
+        rest = n_items % largest
+        if rest:
+            plan.append(self.batch_for(seq, rest))
+        return plan
+
     def drop(self, bucket: Bucket) -> bool:
         """Remove one bucket (HBM pre-flight shrinking an over-committed
         grid at warmup instead of OOMing mid-traffic). Returns False when

@@ -144,6 +144,10 @@ class RequestTicket:
         self.request_id = request_id if request_id is not None \
             else next(_REQUEST_IDS)
         self.n_chunks = n_chunks
+        # long-request provenance (ISSUE 20): how many dedicated scatter
+        # batches this request's chunks launched as; 0 = the ordinary
+        # coalescing queue served it
+        self.scatter_batches = 0
         self.question_len = question_len
         self.created_at = time.perf_counter()
         self.chunks: List[List[int]] = []
@@ -202,6 +206,7 @@ class QAEngine:
         quantize: str = "off",
         serve_cache_bytes: int = 0,
         doc_cache_bytes: int = 0,
+        long_scatter_chunks: int = 0,
     ):
         self.model = model
         self.params = params
@@ -214,6 +219,13 @@ class QAEngine:
         self.plan = ParallelPlan.from_mesh(self.mesh)
         self.max_question_len = int(max_question_len)
         self.doc_stride = int(doc_stride)
+        # long-request scatter path (ISSUE 20): a request whose document
+        # windows into at least this many chunks bypasses deadline
+        # coalescing — its chunks launch chunk-parallel as dedicated
+        # batches sliced by ``BucketGrid.scatter_plan`` (a whole book
+        # answers in one POST /v1/qa call, len(plan) device steps).
+        # 0 (default) disables the path.
+        self.long_scatter_chunks = int(long_scatter_chunks or 0)
         self._closed = False
         # the ACTIVE serving precision: callers pass 'int8' when the model/
         # params pair came through quant.quantize_model (cli/serve.py wires
@@ -370,6 +382,13 @@ class QAEngine:
         self.m_aot_load = m.histogram(
             "qa_aot_load_seconds",
             "AOT bucket-program load (deserialize) times on store hits.")
+        self.m_longdoc_requests = m.counter(
+            "qa_longdoc_requests_total",
+            "Requests served through the long-request scatter path "
+            "(chunk-parallel dedicated batches, ISSUE 20).")
+        self.m_longdoc_batches = m.counter(
+            "qa_longdoc_scatter_batches_total",
+            "Dedicated scatter batches launched for long requests.")
         self.m_flight_joins = m.counter(
             "qa_chunk_flight_joins_total",
             "Chunks that piggybacked on an identical in-flight chunk "
@@ -818,7 +837,7 @@ class QAEngine:
                 for idx, seq, input_ids in rows
             ]
             try:
-                self.batcher.submit_many(works)
+                self._admit_works(ticket, works)
             except QueueFullError:
                 self.m_rejected_full.inc()
                 raise
@@ -882,7 +901,7 @@ class QAEngine:
                 )
             if works:
                 try:
-                    self.batcher.submit_many(works)
+                    self._admit_works(ticket, works)
                 except (QueueFullError, DrainingError) as exc:
                     rollback()
                     if isinstance(exc, QueueFullError):
@@ -902,6 +921,32 @@ class QAEngine:
         if done:
             self._finalize(ticket)
         return ticket
+
+    def _admit_works(self, ticket: RequestTicket, works: List) -> None:
+        """Queue one request's chunk works: the coalescing queue normally,
+        or — when the request windows into at least ``long_scatter_chunks``
+        chunks — the long-request scatter path: per-seq slices from
+        ``BucketGrid.scatter_plan`` submitted as dedicated batches that
+        launch immediately and back-to-back (``MicroBatcher.submit_group``).
+        Raises exactly what ``submit_many`` raises; on rejection nothing is
+        queued (the group admission is all-or-nothing too)."""
+        if not self.long_scatter_chunks or \
+                len(works) < self.long_scatter_chunks:
+            self.batcher.submit_many(works)
+            return
+        by_seq: Dict[int, List] = {}
+        for w in works:
+            by_seq.setdefault(w.seq, []).append(w)
+        slices = []
+        for seq in sorted(by_seq):
+            ws = by_seq[seq]
+            for batch in self.grid.scatter_plan(seq, len(ws)):
+                slices.append(ws[:batch])
+                ws = ws[batch:]
+        self.batcher.submit_group(slices)
+        ticket.scatter_batches = len(slices)
+        self.m_longdoc_requests.inc()
+        self.m_longdoc_batches.inc(len(slices))
 
     # -- batch execution (batcher thread) --------------------------------------
 

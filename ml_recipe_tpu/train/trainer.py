@@ -345,6 +345,13 @@ class Trainer:
         if self.mesh is None:
             self.mesh = build_mesh()
 
+        if self.prng_impl == "threefry2x32":
+            # threefry is the mesh-invariant choice (module docstring);
+            # that only holds with index-keyed bits — see compat shim.
+            from ..parallel.compat import ensure_partitionable_threefry
+
+            ensure_partitionable_threefry()
+
         # The declarative parallelism plan (parallel/plan.py): every
         # layout below — batch placement, param/opt-state shardings, the
         # ZeRO-1 leaf plan, the pipeline stage layout, the manifest/
@@ -786,9 +793,15 @@ class Trainer:
 
         ``leading_accum``: leaves are [G, B, ...] (micro-batch major) and the
         batch dim is axis 1; otherwise leaves are [B, ...] with batch axis 0.
+
+        Ring attention additionally places the token dim over the ``seq``
+        axis at ingest, so the embedding lookup and every activation up to
+        the ring shard_map are born sequence-sharded — at 8k+ the
+        replicated-activation alternative is the memory ceiling.
         """
         return make_global_array(
-            tree, self.mesh, batch_axis=1 if leading_accum else 0
+            tree, self.mesh, batch_axis=1 if leading_accum else 0,
+            shard_seq=getattr(self.model, "attention_impl", None) == "ring",
         )
 
     def _split_micro(self, tree):

@@ -319,6 +319,44 @@ def test_bench_serve_hot_set_workload_pins_cache_win(bench, capsys):
     assert parsed["p50_hit_ms"] * 5 <= parsed["p50_miss_ms"], parsed
 
 
+def test_bench_serve_long_request_leg_pins_longdoc_json(bench, capsys):
+    """ISSUE 20 satellite: ``--mode serve`` with ``--serve_long_doc_tokens``
+    drives one multi-thousand-token synthetic document through the long
+    buckets after the closed loop; its sliding-window chunks scatter
+    chunk-parallel across dedicated batches and the JSON line gains
+    ``longdoc_chunks``/``longdoc_scatter_batches`` + longdoc p50/p95."""
+    import types
+
+    args = types.SimpleNamespace(
+        model="bert-tiny",
+        serve_buckets="4x64,16x64",
+        serve_clients=2,
+        serve_requests=4,
+        serve_queue_size=256,
+        serve_long_doc_tokens=2048,
+        serve_long_requests=2,
+        max_batch_delay_ms=5.0,
+        doc_stride=32,
+        ln_impl="xla",
+        hbm_preflight=False,
+    )
+    bench.bench_serve(args)
+    out = capsys.readouterr().out.strip().splitlines()
+    parsed = json.loads(out[-1])
+    assert parsed["requests"] == 4 and parsed["failed"] == 0
+    assert parsed["longdoc_tokens"] == 2048
+    # a ~2k-token document windows into dozens of chunks at seq 64
+    assert parsed["longdoc_chunks"] > 16
+    # ...which scatter into ceil(chunks / 16) dedicated batches — far
+    # fewer launches than chunks (the chunk-parallel win)
+    expected = -(-parsed["longdoc_chunks"] // 16)
+    assert parsed["longdoc_scatter_batches"] == expected
+    assert parsed["longdoc_p50_ms"] > 0
+    assert parsed["longdoc_p50_ms"] <= parsed["longdoc_p95_ms"]
+    # the leg must not perturb the headline closed-loop numbers' shape
+    assert parsed["p50_ms"] > 0 and parsed["batches"] >= 1
+
+
 def test_bench_fleet_pins_affinity_cache_win(bench, capsys):
     """ISSUE-18 acceptance: ``bench.py --mode fleet`` runs the SAME seeded
     zipf schedule through a consistent-hash tier and a random-routing tier

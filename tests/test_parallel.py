@@ -102,7 +102,7 @@ def test_make_global_array(eight_devices):
 
 def test_pmean_matches_ddp_mean(eight_devices):
     """Gradient pmean over the data axis == DDP's world-mean contract."""
-    from jax import shard_map
+    from ml_recipe_tpu.parallel.compat import shard_map
 
     mesh = build_mesh("data:8")
 

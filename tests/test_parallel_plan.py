@@ -245,7 +245,10 @@ def test_validate_pipeline_plan_errors(tmp_path):
     plan3 = ParallelPlan.from_spec("data:1,pipe:3")  # 2 layers % 3 != 0
     with pytest.raises(ValueError, match="equal contiguous stages"):
         validate_pipeline_plan(plan3, t.model, batch_split=2)
-    with pytest.raises(NotImplementedError, match="shard_map"):
+    # the error must point long-context users at the composed
+    # streaming-ring path and record the follow-up (ISSUE 20)
+    with pytest.raises(NotImplementedError,
+                       match="composed streaming-ring.*ISSUE 20"):
         validate_pipeline_plan(
             ParallelPlan.from_spec("pipe:2,seq:2"), t.model, batch_split=2
         )

@@ -144,12 +144,13 @@ def test_ring_dropout_deterministic_and_seed_sensitive():
     mesh = build_mesh("seq:4")
     q, k, v = _qkv(L=64)
     s1 = jnp.asarray([7], jnp.int32)
-    a = np.asarray(ring_attention(q, k, v, mesh=mesh, rate=0.3, seed=s1))
-    b = np.asarray(ring_attention(q, k, v, mesh=mesh, rate=0.3, seed=s1))
+    # seed as a traced operand: one trace serves all three samples
+    f = jax.jit(lambda s: ring_attention(
+        q, k, v, mesh=mesh, rate=0.3, seed=s))
+    a = np.asarray(f(s1))
+    b = np.asarray(f(s1))
     np.testing.assert_array_equal(a, b)
-    c = np.asarray(ring_attention(
-        q, k, v, mesh=mesh, rate=0.3, seed=jnp.asarray([8], jnp.int32)
-    ))
+    c = np.asarray(f(jnp.asarray([8], jnp.int32)))
     assert not np.allclose(a, c)
     assert np.isfinite(a).all()
 
@@ -160,12 +161,12 @@ def test_ring_dropout_expectation():
     q, k, v = _qkv(B=2, L=32, H=4, seed=3)
     mesh = build_mesh("seq:4")
     base = np.asarray(ring_attention(q, k, v, mesh=mesh))
-    outs = [
-        np.asarray(ring_attention(
-            q, k, v, mesh=mesh, rate=0.2, seed=jnp.asarray([s], jnp.int32)
-        ))
-        for s in range(8)
-    ]
+    # one compile, 8 executions: the seed is a traced operand, so the
+    # shard_map ring is not re-traced per sample
+    dropped = jax.jit(lambda s: ring_attention(
+        q, k, v, mesh=mesh, rate=0.2, seed=s))
+    outs = [np.asarray(dropped(jnp.asarray([s], jnp.int32)))
+            for s in range(8)]
     avg = np.mean(outs, axis=0)
     assert np.abs(avg - base).mean() < 0.05 * np.abs(base).mean() + 0.05
 
@@ -181,6 +182,7 @@ def test_ring_dropout_gradients_flow():
     w = jnp.asarray(rng.normal(size=q.shape), jnp.float32)
     dv = jnp.asarray(rng.normal(size=v.shape), jnp.float32)
 
+    @jax.jit
     def f(v_):
         out = ring_attention(q, k, v_, mesh=mesh, rate=0.3, seed=seed)
         return jnp.sum(out * w)

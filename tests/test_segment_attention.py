@@ -216,12 +216,20 @@ def test_dispatcher_xla_path_applies_segments():
     )
 
 
-def test_dispatcher_rejects_ring_with_segments():
+def test_dispatcher_ring_segments_need_composed_inner(eight_devices):
+    """Segment ids route through the composed streaming-ring inner; at a
+    local length with no legal streaming geometry (L_loc=32 here) the
+    dense inner cannot serve them and ring_attention must say so instead
+    of silently dropping the block-diagonal mask."""
+    from ml_recipe_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh("seq:2")
     rng = np.random.default_rng(8)
     q, k, v = _qkv(rng, 1, 64, 2, 8)
     seg = _segments(1, 64, [[64]])
-    with pytest.raises(ValueError, match="ring"):
-        dot_product_attention(q, k, v, None, impl="ring", segment_ids=seg)
+    with pytest.raises(NotImplementedError, match="streaming-ring"):
+        dot_product_attention(q, k, v, None, impl="ring", mesh=mesh,
+                              segment_ids=seg)
 
 
 def test_dispatcher_auto_on_cpu_routes_segmented_to_xla():

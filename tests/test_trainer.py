@@ -63,21 +63,21 @@ class TP:
 def _make_trainer(tmp_path, *, batch_split=1, n_epochs=1, debug=False,
                   train_len=32, test_len=10, dropout=0.1, tp_cls=TP,
                   mesh_spec="data:8", attention_impl="xla", ln_impl="xla",
-                  **trainer_extra):
+                  max_seq_len=MAX_SEQ_LEN, **trainer_extra):
     tokenizer = make_tokenizer(tmp_path)
     rng = np.random.default_rng(0)
     train_ds = DummyDataset(
-        tokenizer=tokenizer, max_seq_len=MAX_SEQ_LEN, max_question_len=MAX_Q_LEN,
+        tokenizer=tokenizer, max_seq_len=max_seq_len, max_question_len=MAX_Q_LEN,
         dataset_len=train_len, rng=rng,
     )
     test_ds = DummyDataset(
-        tokenizer=tokenizer, max_seq_len=MAX_SEQ_LEN, max_question_len=MAX_Q_LEN,
+        tokenizer=tokenizer, max_seq_len=max_seq_len, max_question_len=MAX_Q_LEN,
         dataset_len=test_len, rng=rng,
     )
 
     cfg = EncoderConfig(
         vocab_size=len(tokenizer), hidden_size=16, num_layers=2, num_heads=2,
-        intermediate_size=32, max_position_embeddings=MAX_SEQ_LEN + 2, num_labels=5,
+        intermediate_size=32, max_position_embeddings=max_seq_len + 2, num_labels=5,
         hidden_dropout_prob=dropout, attention_probs_dropout_prob=dropout,
     )
     mesh = build_mesh(mesh_spec)
@@ -95,7 +95,7 @@ def _make_trainer(tmp_path, *, batch_split=1, n_epochs=1, debug=False,
         model=model,
         params=params,
         loss=build_loss(tp_cls()),
-        collate_fun=make_collate_fun(tokenizer, max_seq_len=MAX_SEQ_LEN),
+        collate_fun=make_collate_fun(tokenizer, max_seq_len=max_seq_len),
         trainer_params=tp_cls(),
         train_dataset=train_ds,
         test_dataset=test_ds,
